@@ -1,0 +1,28 @@
+"""Common definitions & utils (L0).
+
+Reference counterpart: ``packages/common/`` — ``@fluidframework/core-interfaces``,
+``@fluidframework/protocol-definitions`` (reference mount empty; upstream package
+names per SURVEY.md §1 L0).
+"""
+
+from .constants import (
+    SEQ_UNASSIGNED,
+    SEQ_UNIVERSAL,
+    NO_CLIENT,
+    NOT_REMOVED,
+)
+from .protocol import (
+    MessageType,
+    DocumentMessage,
+    SequencedDocumentMessage,
+)
+
+__all__ = [
+    "SEQ_UNASSIGNED",
+    "SEQ_UNIVERSAL",
+    "NO_CLIENT",
+    "NOT_REMOVED",
+    "MessageType",
+    "DocumentMessage",
+    "SequencedDocumentMessage",
+]

@@ -1,0 +1,24 @@
+"""Sequence-number sentinels shared by oracle and tensor kernels.
+
+Reference counterpart: ``@fluidframework/merge-tree`` ``constants.ts``
+(``UnassignedSequenceNumber``, ``UniversalSequenceNumber``, ``NonCollabClient``)
+— mount empty, names per SURVEY.md §2.1.
+
+The tensor kernels need every sentinel to be an int32 that keeps ordinary
+``<=`` comparisons meaningful, so the sentinels here are chosen for both worlds:
+
+- ``SEQ_UNASSIGNED``: a pending local op that has not been sequenced yet. Only
+  the *client-side* (oracle) state ever holds this; the device-resident state is
+  acked-only (sequenced ops only), which is what makes the kernels clean.
+- ``SEQ_UNIVERSAL``: state loaded from a summary — visible to every perspective.
+- ``NOT_REMOVED``: "removedSeq" value for a live segment. Chosen as +inf-like so
+  ``removed_seq <= ref_seq`` is naturally false for live segments in vectorized
+  visibility masks.
+"""
+
+SEQ_UNASSIGNED = -1
+SEQ_UNIVERSAL = 0
+NO_CLIENT = -1
+
+# int32-max-ish sentinel for "not removed"; keeps removed_seq <= ref_seq false.
+NOT_REMOVED = 2**31 - 1

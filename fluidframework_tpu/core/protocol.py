@@ -1,0 +1,68 @@
+"""Wire/op message types (protocol definitions).
+
+Reference counterpart: ``@fluidframework/protocol-definitions`` —
+``IDocumentMessage`` (client → ordering service) and
+``ISequencedDocumentMessage`` (ordering service → every client), plus
+``MessageType`` (mount empty; names per SURVEY.md §1 L0 / §3.2).
+
+Design note (TPU-first): these dataclasses are the *host-side* representation
+used by the interactive client library, the sequencer, and tests. The device
+path never sees Python objects — ops are packed into fixed-width int32
+struct-of-arrays records (see ``fluidframework_tpu.ops.schema``) with
+variable-length payloads (text, JSON values) kept in a host-side side table and
+referenced by handle. The TPU does ordering/position math, not string bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+
+class MessageType(enum.IntEnum):
+    """Op type at the protocol layer (reference: MessageType in protocol-definitions)."""
+
+    OP = 0            # runtime-level operation (routed to datastores/DDSes)
+    NOOP = 1          # heartbeat carrying referenceSequenceNumber (advances MSN)
+    CLIENT_JOIN = 2   # quorum: client joined
+    CLIENT_LEAVE = 3  # quorum: client left
+    PROPOSAL = 4      # quorum proposal (e.g. code proposal)
+    SUMMARIZE = 5     # summary op submitted by the summarizer client
+    SUMMARY_ACK = 6   # service accepted a summary
+    SUMMARY_NACK = 7  # service rejected a summary
+    REJOIN = 8
+
+
+@dataclasses.dataclass
+class DocumentMessage:
+    """A client-submitted, not-yet-sequenced op (reference: IDocumentMessage)."""
+
+    client_seq: int                 # clientSequenceNumber: per-client monotone counter
+    ref_seq: int                    # referenceSequenceNumber: last seq client had processed
+    type: MessageType
+    contents: Any = None            # DDS/runtime payload (address-routed envelope)
+    metadata: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class SequencedDocumentMessage:
+    """A sequenced op as broadcast to all clients (reference: ISequencedDocumentMessage).
+
+    The ordering service stamps ``seq`` (the global total order within a document)
+    and ``min_seq`` (minimum of connected clients' reference sequence numbers —
+    the collaboration window floor used for eventual cleanup / zamboni).
+    """
+
+    doc_id: str
+    client_id: int                  # sequenced client id (NO_CLIENT for service msgs)
+    client_seq: int
+    ref_seq: int
+    seq: int
+    min_seq: int
+    type: MessageType
+    contents: Any = None
+    metadata: Optional[dict] = None
+
+    def is_from(self, client_id: int) -> bool:
+        return self.client_id == client_id

@@ -1,0 +1,148 @@
+"""Merge-tree Client: translates between ops and MergeTree calls.
+
+Reference counterpart: ``@fluidframework/merge-tree`` ``Client``
+(``applyMsg``, ``insertSegmentLocal``, ``ackPendingSegment`` — SURVEY.md §2.1,
+§3.2/§3.3; mount empty). One Client == one replica's view of one sequence.
+
+Local edits apply optimistically (latency-free) with ``SEQ_UNASSIGNED`` stamps
+and produce op payloads; the sequenced echo of our own op is the ack that
+converts pending state into committed state. Remote sequenced ops apply in the
+perspective ``(op.ref_seq, op.client)``.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, Optional
+
+from ..core.constants import SEQ_UNASSIGNED
+from ..core.protocol import MessageType, SequencedDocumentMessage
+from .merge_tree import MergeTree, SegmentKind, LOCAL_VIEW
+
+
+class SequenceClient:
+    def __init__(self, client_id: int):
+        self.client_id = client_id
+        self.tree = MergeTree(client_id)
+        self.client_seq = 0
+        self.last_processed_seq = 0
+        self.pending = collections.deque()  # FIFO of (client_seq, kind)
+
+    # ----------------------------------------------------------- local edits
+
+    def _check_pos(self, pos: int) -> None:
+        if not 0 <= pos <= self.get_length():
+            raise IndexError(f"position {pos} outside [0, {self.get_length()}]")
+
+    def _check_range(self, start: int, end: int) -> None:
+        if not 0 <= start < end <= self.get_length():
+            raise IndexError(
+                f"range [{start},{end}) invalid for length {self.get_length()}"
+            )
+
+    def _record_pending(self, kind: str) -> int:
+        # Called only after the tree mutation succeeded, so a rejected local
+        # edit can never leave a phantom entry that desyncs later acks.
+        self.pending.append((self.client_seq, kind))
+        return self.client_seq
+
+    def insert_text_local(self, pos: int, text: str,
+                          props: Optional[dict] = None) -> Dict[str, Any]:
+        self._check_pos(pos)
+        self.client_seq += 1
+        self.tree.insert(
+            pos, SegmentKind.TEXT, text, SEQ_UNASSIGNED, self.client_id,
+            LOCAL_VIEW, props=props, local_op=self.client_seq,
+        )
+        op_id = self._record_pending("insert")
+        return {"mt": "insert", "pos": pos, "kind": int(SegmentKind.TEXT),
+                "text": text, "props": props, "clientSeq": op_id}
+
+    def insert_marker_local(self, pos: int,
+                            props: Optional[dict] = None) -> Dict[str, Any]:
+        self._check_pos(pos)
+        self.client_seq += 1
+        self.tree.insert(
+            pos, SegmentKind.MARKER, "", SEQ_UNASSIGNED, self.client_id,
+            LOCAL_VIEW, props=props, local_op=self.client_seq,
+        )
+        op_id = self._record_pending("insert")
+        return {"mt": "insert", "pos": pos, "kind": int(SegmentKind.MARKER),
+                "text": "", "props": props, "clientSeq": op_id}
+
+    def remove_range_local(self, start: int, end: int) -> Dict[str, Any]:
+        self._check_range(start, end)
+        self.client_seq += 1
+        self.tree.mark_range_removed(
+            start, end, SEQ_UNASSIGNED, self.client_id, LOCAL_VIEW,
+            local_op=self.client_seq,
+        )
+        op_id = self._record_pending("remove")
+        return {"mt": "remove", "start": start, "end": end, "clientSeq": op_id}
+
+    def annotate_range_local(self, start: int, end: int,
+                             props: dict) -> Dict[str, Any]:
+        self._check_range(start, end)
+        self.client_seq += 1
+        self.tree.annotate_range(
+            start, end, props, SEQ_UNASSIGNED, self.client_id, LOCAL_VIEW,
+            local_op=self.client_seq,
+        )
+        op_id = self._record_pending("annotate")
+        return {"mt": "annotate", "start": start, "end": end, "props": props,
+                "clientSeq": op_id}
+
+    # ------------------------------------------------------- sequenced inbox
+
+    def apply_msg(self, msg: SequencedDocumentMessage) -> None:
+        """Process one sequenced op (reference: Client.applyMsg)."""
+        assert msg.seq > self.last_processed_seq, "ops must arrive in seq order"
+        if msg.type == MessageType.OP and msg.contents is not None:
+            if msg.client_id == self.client_id:
+                self._ack(msg)
+            else:
+                self._apply_remote(msg)
+        self.last_processed_seq = msg.seq
+        if msg.min_seq > self.tree.min_seq:
+            self.tree.zamboni(msg.min_seq)
+
+    def _ack(self, msg: SequencedDocumentMessage) -> None:
+        op = msg.contents
+        assert self.pending, "ack with no pending op"
+        op_id, kind = self.pending.popleft()
+        assert op_id == op["clientSeq"] and kind == op["mt"], (
+            "sequenced echo out of order vs pending queue"
+        )
+        if kind == "insert":
+            self.tree.ack_insert(op_id, msg.seq)
+        elif kind == "remove":
+            self.tree.ack_remove(op_id, msg.seq)
+        elif kind == "annotate":
+            self.tree.ack_annotate(op_id, msg.seq)
+
+    def _apply_remote(self, msg: SequencedDocumentMessage) -> None:
+        op = msg.contents
+        if op["mt"] == "insert":
+            self.tree.insert(
+                op["pos"], SegmentKind(op["kind"]), op["text"],
+                msg.seq, msg.client_id, msg.ref_seq, props=op.get("props"),
+            )
+        elif op["mt"] == "remove":
+            self.tree.mark_range_removed(
+                op["start"], op["end"], msg.seq, msg.client_id, msg.ref_seq,
+            )
+        elif op["mt"] == "annotate":
+            self.tree.annotate_range(
+                op["start"], op["end"], op["props"], msg.seq, msg.client_id,
+                msg.ref_seq,
+            )
+        else:
+            raise ValueError(f"unknown merge-tree op {op['mt']!r}")
+
+    # ----------------------------------------------------------------- views
+
+    def get_text(self) -> str:
+        return self.tree.get_text()
+
+    def get_length(self) -> int:
+        return self.tree.get_length()

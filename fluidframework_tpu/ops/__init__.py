@@ -1,0 +1,13 @@
+"""Tensor op schema and batched device kernels (the op-merge engine).
+
+This is the TPU-native replacement for the reference's hot path
+(``ContainerRuntime.process`` → ``SharedObject.process`` → ``MergeTree``
+insert/remove — SURVEY.md §3.2): instead of an object-graph walk per op, ops are
+fixed-width packed records in a (doc × op) batch and one jit'd step applies them
+for thousands of documents at once, with the op axis a ``lax.scan`` (total order
+within a doc is a hard data dependency) and the doc axis vmapped/sharded.
+"""
+
+from .schema import OpKind, OpBatch, SEGMENT_FIELDS
+
+__all__ = ["OpKind", "OpBatch", "SEGMENT_FIELDS"]

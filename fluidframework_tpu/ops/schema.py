@@ -1,0 +1,110 @@
+"""Packed fixed-width op records: the device-side wire format.
+
+Reference counterpart: ``ISequencedDocumentMessage`` + per-DDS op contents
+(``@fluidframework/protocol-definitions``, merge-tree ``IMergeTreeOp``; mount
+empty — SURVEY.md §7.2). The reference ships ops as JSON; a TPU cannot chase
+JSON, so ops become struct-of-arrays int32 records:
+
+    doc        — document index within the resident batch (the DP axis)
+    client     — sequenced client id
+    client_seq — per-client monotone counter (dedupe key at the sequencer)
+    ref_seq    — referenceSequenceNumber (the perspective for position resolve)
+    seq        — global per-doc sequence number (stamped by the sequencer)
+    kind       — OpKind below
+    a0/a1/a2   — op-kind-specific args (positions, lengths, key ids, handles)
+
+Variable-length payloads (text bytes, JSON values) never reach the device: they
+live in a host-side payload table, and records carry integer handles + lengths.
+Position math — the actual hot path — only needs lengths.
+
+Device-resident state is *acked-only*: every op in a batch has a real ``seq``.
+Optimistic local state, acks and rebase are a host/client concern
+(``fluidframework_tpu.models``); the device is the replica/server merge engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import numpy as np
+
+
+class OpKind(enum.IntEnum):
+    # merge-tree / SharedString ops (reference: IMergeTreeOp types)
+    STR_INSERT = 0    # a0=pos, a1=len, a2=payload handle
+    STR_REMOVE = 1    # a0=start, a1=end
+    STR_ANNOTATE = 2  # a0=start, a1=end, a2=props handle
+    # map ops (reference: @fluidframework/map IDirectoryOperation)
+    MAP_SET = 3       # a0=key id, a1=value handle
+    MAP_DELETE = 4    # a0=key id
+    MAP_CLEAR = 5
+    # matrix ops (reference: @fluidframework/matrix)
+    MAT_SET_CELL = 6  # a0=row handle, a1=col handle, a2=value handle
+    MAT_INSERT_ROWS = 7  # a0=pos, a1=count
+    MAT_INSERT_COLS = 8
+    MAT_REMOVE_ROWS = 9  # a0=start, a1=count
+    MAT_REMOVE_COLS = 10
+    # counter
+    COUNTER_INCREMENT = 11  # a0=delta
+    NOOP = 12         # heartbeat: advances client ref_seq for MSN only
+
+
+N_OP_FIELDS = 9
+OP_FIELDS = (
+    "doc", "client", "client_seq", "ref_seq", "seq", "kind", "a0", "a1", "a2",
+)
+
+# Per-segment state columns for the tensorized MergeTree (ops/merge_tree_kernel).
+SEGMENT_FIELDS = (
+    "seq",            # insert seq (SEQ_UNIVERSAL for summary-loaded)
+    "client",         # inserting client
+    "removed_seq",    # NOT_REMOVED if live
+    "length",         # character length
+    "handle",         # payload handle: (op id << 8 | split ordinal) — host text table
+    "active",         # slot in use (0/1)
+)
+
+
+@dataclasses.dataclass
+class OpBatch:
+    """A batch of sequenced ops as struct-of-arrays, shape (n_ops,) each.
+
+    Ops in a batch are globally ordered by ``seq`` (ascending) and may target
+    many docs; per-doc order is a subsequence of the batch order, preserving
+    the total order the sequencer assigned.
+    """
+
+    doc: np.ndarray
+    client: np.ndarray
+    client_seq: np.ndarray
+    ref_seq: np.ndarray
+    seq: np.ndarray
+    kind: np.ndarray
+    a0: np.ndarray
+    a1: np.ndarray
+    a2: np.ndarray
+
+    def __len__(self) -> int:
+        return int(self.doc.shape[0])
+
+    @staticmethod
+    def empty(n: int) -> "OpBatch":
+        z = lambda: np.zeros((n,), dtype=np.int32)
+        return OpBatch(z(), z(), z(), z(), z(), z(), z(), z(), z())
+
+    @staticmethod
+    def from_records(records) -> "OpBatch":
+        """records: iterable of (doc, client, client_seq, ref_seq, seq, kind, a0, a1, a2)."""
+        arr = np.asarray(list(records), dtype=np.int32).reshape(-1, N_OP_FIELDS)
+        return OpBatch(*(np.ascontiguousarray(arr[:, i]) for i in range(N_OP_FIELDS)))
+
+    def as_stacked(self) -> np.ndarray:
+        """(n_ops, N_OP_FIELDS) int32 view for device transfer as one array."""
+        return np.stack(
+            [getattr(self, f) for f in OP_FIELDS], axis=1
+        ).astype(np.int32)
+
+    @staticmethod
+    def from_stacked(arr: np.ndarray) -> "OpBatch":
+        return OpBatch(*(np.ascontiguousarray(arr[:, i]) for i in range(N_OP_FIELDS)))

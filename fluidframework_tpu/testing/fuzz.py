@@ -1,0 +1,79 @@
+"""Seeded fuzz generators + convergence checking.
+
+Reference counterpart: ``@fluid-private/test-dds-utils`` DDS fuzz harness +
+``stochastic-test-utils`` (SURVEY.md §4): seeded random op generators, random
+interleavings (including partial sequencing so ops cross in flight), then
+assert every replica converged — deep-equal text, properties, and structure
+digest. Failure seeds are plain ints, so a failing case is reproducible with
+``run_sequence_fuzz(seed)``.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+from typing import List
+
+from ..core.protocol import MessageType
+from ..models.merge_tree_client import SequenceClient
+from .mocks import MockSequencer
+
+
+def _rand_text(rng: random.Random, lo: int = 1, hi: int = 6) -> str:
+    n = rng.randint(lo, hi)
+    return "".join(rng.choice(string.ascii_lowercase) for _ in range(n))
+
+
+def random_sequence_op(rng: random.Random, client: SequenceClient):
+    """One random local edit on ``client`` (insert-biased, like typing)."""
+    n = client.get_length()
+    roll = rng.random()
+    if n == 0 or roll < 0.55:
+        return client.insert_text_local(rng.randint(0, n), _rand_text(rng))
+    if roll < 0.62:
+        return client.insert_marker_local(rng.randint(0, n))
+    start = rng.randint(0, n - 1)
+    end = rng.randint(start + 1, min(n, start + 8))
+    if roll < 0.85:
+        return client.remove_range_local(start, end)
+    key = rng.choice(["bold", "color", "font"])
+    val = rng.choice([1, 2, "x", None])
+    return client.annotate_range_local(start, end, {key: val})
+
+
+def run_sequence_fuzz(
+    seed: int,
+    n_clients: int = 3,
+    n_rounds: int = 25,
+    ops_per_round: int = 4,
+    with_noops: bool = True,
+) -> List[SequenceClient]:
+    """Random edit storm with partial in-flight sequencing; returns converged
+    replicas (raises AssertionError on divergence)."""
+    rng = random.Random(seed)
+    seqr = MockSequencer()
+    clients = [SequenceClient(seqr.allocate_client_id()) for _ in range(n_clients)]
+    for c in clients:
+        seqr.connect(c)
+    for _ in range(n_rounds):
+        for _ in range(ops_per_round):
+            c = rng.choice(clients)
+            op = random_sequence_op(rng, c)
+            seqr.submit(c, op)
+        # sometimes let ops cross mid-flight, sometimes drain fully
+        seqr.process_some(rng.randint(0, seqr.outstanding))
+        if with_noops and rng.random() < 0.3:
+            # heartbeat: advances MSN so zamboni actually runs during the fuzz
+            c = rng.choice(clients)
+            seqr.submit(c, {}, type=MessageType.NOOP)
+    seqr.process_all_messages()
+    assert_converged(clients)
+    return clients
+
+
+def assert_converged(clients: List[SequenceClient]) -> None:
+    texts = {c.get_text() for c in clients}
+    assert len(texts) == 1, f"replica text divergence: {texts}"
+    digests = {c.tree.structure_digest() for c in clients}
+    assert len(digests) == 1, "replica structure divergence (props/markers)"
+    assert all(not c.pending for c in clients), "unacked pending ops remain"

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+
+Multi-chip hardware is not available in this environment; per the build
+instructions, sharding/collective paths are validated on a virtual CPU mesh
+(``--xla_force_host_platform_device_count=8``). Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,19 @@
+"""Seeded convergence fuzzing of the merge-tree oracle (SURVEY.md §4 pattern).
+
+Random multi-client edit storms with ops crossing in flight; every replica must
+converge on text, properties and structure. Seeds are the reproduction handle.
+"""
+
+import pytest
+
+from fluidframework_tpu.testing.fuzz import run_sequence_fuzz
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_sequence_convergence_fuzz(seed):
+    run_sequence_fuzz(seed, n_clients=3, n_rounds=25, ops_per_round=4)
+
+
+@pytest.mark.parametrize("seed", [100, 101, 102])
+def test_sequence_convergence_fuzz_many_clients(seed):
+    run_sequence_fuzz(seed, n_clients=5, n_rounds=15, ops_per_round=6)

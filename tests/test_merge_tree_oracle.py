@@ -1,0 +1,191 @@
+"""Merge-tree oracle semantics: the anchor behaviors every kernel must match.
+
+These pin the Fluid merge rules (reference: @fluidframework/merge-tree, mount
+empty — SURVEY.md §2.1): perspective-based position resolution, concurrent
+insert tie-break, remove-vs-insert interleavings, overlapping removes, annotate
+LWW, zamboni, local references, and summary roundtrip.
+"""
+
+import pytest
+
+from fluidframework_tpu.core.protocol import MessageType
+from fluidframework_tpu.models.merge_tree import SegmentKind, SlidePolicy
+from fluidframework_tpu.models.merge_tree_client import SequenceClient
+from fluidframework_tpu.testing.mocks import MockSequencer
+from fluidframework_tpu.testing.fuzz import assert_converged
+
+
+def make_collab(n):
+    seqr = MockSequencer()
+    clients = [SequenceClient(seqr.allocate_client_id()) for _ in range(n)]
+    for c in clients:
+        seqr.connect(c)
+    return seqr, clients
+
+
+def submit(seqr, client, op):
+    seqr.submit(client, op)
+
+
+def test_local_insert_at_same_position_stacks_leftward():
+    _, (a,) = make_collab(1)
+    a.insert_text_local(0, "a")
+    a.insert_text_local(0, "b")
+    assert a.get_text() == "ba"
+
+
+def test_sequential_typing():
+    seqr, (a, b) = make_collab(2)
+    for i, ch in enumerate("hello"):
+        submit(seqr, a, a.insert_text_local(i, ch))
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "hello"
+
+
+def test_concurrent_insert_same_position_later_seq_wins_left():
+    seqr, (a, b) = make_collab(2)
+    submit(seqr, a, a.insert_text_local(0, "a"))   # will be seq 1
+    submit(seqr, b, b.insert_text_local(0, "x"))   # will be seq 2, refSeq 0
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "xa"
+
+
+def test_concurrent_typing_runs_stay_contiguous():
+    seqr, (a, b) = make_collab(2)
+    for i, ch in enumerate("abc"):
+        submit(seqr, a, a.insert_text_local(i, ch))
+    for i, ch in enumerate("xyz"):
+        submit(seqr, b, b.insert_text_local(i, ch))
+    seqr.process_all_messages()
+    # B's ops sequenced after A's at the same origin position -> B lands left,
+    # and each client's run is contiguous (never interleaved).
+    assert a.get_text() == b.get_text() == "xyzabc"
+
+
+def test_insert_into_concurrently_removed_range_survives():
+    seqr, (a, b) = make_collab(2)
+    submit(seqr, a, a.insert_text_local(0, "abcd"))
+    seqr.process_all_messages()
+    # concurrent: B removes [1,3) while A inserts "XX" at 2
+    submit(seqr, b, b.remove_range_local(1, 3))
+    submit(seqr, a, a.insert_text_local(2, "XX"))
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "aXXd"
+
+
+def test_remove_does_not_cover_concurrent_insert():
+    seqr, (a, b) = make_collab(2)
+    submit(seqr, a, a.insert_text_local(0, "abcd"))
+    seqr.process_all_messages()
+    # A inserts inside [1,3) first in sequence order; B's remove was issued
+    # without seeing it -> the inserted text survives.
+    submit(seqr, a, a.insert_text_local(2, "ZZ"))
+    submit(seqr, b, b.remove_range_local(1, 3))
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "aZZd"
+
+
+def test_overlapping_concurrent_removes():
+    seqr, (a, b, c) = make_collab(3)
+    submit(seqr, a, a.insert_text_local(0, "abcdef"))
+    seqr.process_all_messages()
+    submit(seqr, a, a.remove_range_local(0, 4))
+    submit(seqr, b, b.remove_range_local(2, 6))
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == c.get_text() == ""
+    # earliest acked removal seq is kept; both removers recorded
+    tomb = [s for s in c.tree.segments if s.removed_seq is not None]
+    overlap = [s for s in tomb if len(s.removers) == 2]
+    assert overlap and all(s.removed_seq == 2 for s in overlap)
+
+
+def test_annotate_last_sequenced_writer_wins():
+    seqr, (a, b, c) = make_collab(3)
+    submit(seqr, a, a.insert_text_local(0, "mm"))
+    seqr.process_all_messages()
+    submit(seqr, a, a.annotate_range_local(0, 2, {"bold": 1}))
+    submit(seqr, b, b.annotate_range_local(0, 2, {"bold": 2}))
+    seqr.process_all_messages()
+    for cl in (a, b, c):
+        seg, _ = cl.tree.get_containing_segment(0)
+        assert seg.props == {"bold": 2}
+
+
+def test_pending_local_annotate_beats_earlier_remote_after_ack():
+    seqr, (a, b, c) = make_collab(3)
+    submit(seqr, a, a.insert_text_local(0, "mm"))
+    seqr.process_all_messages()
+    submit(seqr, b, b.annotate_range_local(0, 2, {"k": "B"}))  # seq 2
+    submit(seqr, a, a.annotate_range_local(0, 2, {"k": "A"}))  # seq 3
+    seqr.process_all_messages()
+    for cl in (a, b, c):
+        seg, _ = cl.tree.get_containing_segment(0)
+        assert seg.props == {"k": "A"}
+    assert_converged([a, b, c])
+
+
+def test_marker_insert_and_convergence():
+    seqr, (a, b) = make_collab(2)
+    submit(seqr, a, a.insert_text_local(0, "ab"))
+    seqr.process_all_messages()
+    submit(seqr, a, a.insert_marker_local(1, {"tag": "pg"}))
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "ab"  # markers are out-of-band
+    assert a.get_length() == b.get_length() == 3
+    assert_converged([a, b])
+
+
+def test_zamboni_frees_tombstones_and_coalesces():
+    seqr, (a, b) = make_collab(2)
+    submit(seqr, a, a.insert_text_local(0, "abcdef"))
+    seqr.process_all_messages()
+    submit(seqr, a, a.remove_range_local(1, 3))
+    seqr.process_all_messages()
+    # advance everyone's refSeq so MSN catches up, then heartbeat
+    seqr.submit(a, {}, type=MessageType.NOOP)
+    seqr.submit(b, {}, type=MessageType.NOOP)
+    seqr.process_all_messages()
+    for cl in (a, b):
+        assert cl.get_text() == "adef"
+        assert all(s.removed_seq is None for s in cl.tree.segments)
+    assert_converged([a, b])
+
+
+def test_local_reference_tracks_position_and_slides():
+    seqr, (a, b) = make_collab(2)
+    submit(seqr, a, a.insert_text_local(0, "abcdef"))
+    seqr.process_all_messages()
+    ref = a.tree.create_local_reference(3, SlidePolicy.SLIDE)  # at 'd'
+    submit(seqr, b, b.insert_text_local(0, "XX"))
+    seqr.process_all_messages()
+    assert a.tree.get_position(ref.segment, ref.offset) == 5  # shifted by 2
+    # remove the segment under the ref, zamboni, ref slides forward
+    submit(seqr, b, b.remove_range_local(4, 6))  # removes 'cd' (post-shift)
+    seqr.process_all_messages()
+    seqr.submit(a, {}, type=MessageType.NOOP)
+    seqr.submit(b, {}, type=MessageType.NOOP)
+    seqr.process_all_messages()
+    assert a.get_text() == "XXabef"
+    pos = a.tree.get_position(ref.segment, ref.offset)
+    assert pos == 4  # slid to 'e'
+
+
+def test_summary_roundtrip():
+    seqr, (a, b) = make_collab(2)
+    submit(seqr, a, a.insert_text_local(0, "hello world"))
+    submit(seqr, b, b.insert_text_local(0, "hi "))
+    seqr.process_all_messages()
+    submit(seqr, a, a.remove_range_local(0, 3))
+    seqr.process_all_messages()
+    from fluidframework_tpu.models.merge_tree import MergeTree
+    summary = a.tree.summarize()
+    loaded = MergeTree.load(summary, local_client=99)
+    assert loaded.get_text() == a.get_text()
+    assert loaded.structure_digest() == a.tree.structure_digest()
+
+
+def test_insert_position_beyond_length_raises():
+    _, (a,) = make_collab(1)
+    a.insert_text_local(0, "ab")
+    with pytest.raises(IndexError):
+        a.insert_text_local(5, "x")
