@@ -63,6 +63,9 @@ class SequencedDocumentMessage:
     type: MessageType
     contents: Any = None
     metadata: Optional[dict] = None
+    # channel routing address (reference: the /dataStoreId/channelId envelope
+    # the container runtime routes by — SURVEY.md §3.2). None = document-level.
+    address: Optional[str] = None
 
     def is_from(self, client_id: int) -> bool:
         return self.client_id == client_id

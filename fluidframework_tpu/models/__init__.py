@@ -1,1 +1,31 @@
-"""(populated as the build proceeds)"""
+"""The DDS layer (L4): collaborative data structures with Fluid merge
+semantics, as oracle (host) implementations.
+
+Reference counterpart: ``packages/dds/*`` (SURVEY.md §2.1–§2.7; mount empty).
+These are the executable specification for the batched device kernels in
+``fluidframework_tpu.ops`` and the interactive client API.
+"""
+
+from .merge_tree import (
+    MergeTree, Segment, SegmentKind, SlidePolicy, LocalReference, LOCAL_VIEW,
+)
+from .merge_tree_client import SequenceClient
+from .shared_object import (
+    SharedObject, ChannelFactory, ChannelRegistry, default_registry,
+)
+from .shared_map import SharedMap, SharedDirectory, MapKernel
+from .shared_string import SharedString
+from .shared_matrix import SharedMatrix
+from .interval_collection import IntervalCollection, SequenceInterval
+from .small_dds import (
+    SharedCounter, SharedCell, RegisterCollection, ConsensusQueue, TaskManager,
+)
+
+__all__ = [
+    "MergeTree", "Segment", "SegmentKind", "SlidePolicy", "LocalReference",
+    "LOCAL_VIEW", "SequenceClient", "SharedObject", "ChannelFactory",
+    "ChannelRegistry", "default_registry", "SharedMap", "SharedDirectory",
+    "MapKernel", "SharedString", "SharedMatrix", "IntervalCollection",
+    "SequenceInterval", "SharedCounter", "SharedCell", "RegisterCollection",
+    "ConsensusQueue", "TaskManager",
+]

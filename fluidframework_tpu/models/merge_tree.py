@@ -68,20 +68,20 @@ class SlidePolicy(enum.IntEnum):
     TRANSIENT = 2
 
 
-@dataclasses.dataclass
-class LocalReference:
+@dataclasses.dataclass(eq=False)  # identity equality: two refs at the same
+class LocalReference:             # spot are still distinct anchors
     """A position anchored to (segment, offset) that survives remote edits.
 
     Reference: merge-tree ``LocalReferenceCollection`` / ``LocalReferencePosition``.
     """
 
-    segment: "Segment"
+    segment: Optional["Segment"]  # None = detached (document start)
     offset: int
     policy: SlidePolicy = SlidePolicy.SLIDE
     properties: Optional[dict] = None
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # identity equality (segments are places)
 class Segment:
     kind: SegmentKind
     text: str                      # "" for markers
@@ -417,32 +417,54 @@ class MergeTree:
         return ref
 
     def remove_local_reference(self, ref: LocalReference) -> None:
-        if ref in ref.segment.refs:
+        if ref.segment is not None and ref in ref.segment.refs:
             ref.segment.refs.remove(ref)
+
+    def get_ref_position(self, ref: LocalReference) -> int:
+        """Current local-view position of a local reference (detached -> 0)."""
+        if ref.segment is None:
+            return 0
+        return self.get_position(ref.segment, ref.offset)
 
     def _slide_refs(self, idx: int) -> None:
         """Move refs off segments[idx] before physical deletion (zamboni).
 
         SLIDE policy: to the start of the nearest following live segment, else
-        the end of the nearest preceding live segment (reference: SlideOnRemove).
+        the end of the nearest preceding live segment (reference:
+        SlideOnRemove). Targets are chosen in the *acked* view — never a
+        replica-local pending segment — so replicated anchors (interval
+        endpoints) slide identically on every replica.
         """
         seg = self.segments[idx]
         if not seg.refs:
             return
+
+        def acked_live(s: Segment) -> bool:
+            return (
+                s.seq != SEQ_UNASSIGNED
+                and (s.removed_seq is None or s.removed_seq == SEQ_UNASSIGNED)
+            )
+
         target = None
         t_off = 0
         for j in range(idx + 1, len(self.segments)):
-            if _visible(self.segments[j], LOCAL_VIEW, self.local_client):
+            if acked_live(self.segments[j]):
                 target, t_off = self.segments[j], 0
                 break
         if target is None:
             for j in range(idx - 1, -1, -1):
-                if _visible(self.segments[j], LOCAL_VIEW, self.local_client):
+                if acked_live(self.segments[j]):
                     target = self.segments[j]
                     t_off = max(target.length - 1, 0)
                     break
         for ref in seg.refs:
-            if ref.policy == SlidePolicy.TRANSIENT or target is None:
+            if ref.policy == SlidePolicy.TRANSIENT:
+                continue
+            if target is None:
+                # no acked content left anywhere: detach (reference parks at
+                # the document start, like DetachedReferencePosition)
+                ref.segment = None
+                ref.offset = 0
                 continue
             ref.segment = target
             ref.offset = t_off
@@ -484,6 +506,9 @@ class MergeTree:
                 and not prev.pending_annotates
                 and not seg.pending_annotates
                 and prev.props == seg.props
+                # only halves of the SAME insert op re-coalesce: handle[0] is
+                # unique per insert (0 = unknown provenance, never merged)
+                and prev.handle[0] != 0
                 and prev.handle == (seg.handle[0], seg.handle[1] - len(prev.text))
             ):
                 # coalesce: identical visibility for every future perspective

@@ -46,6 +46,12 @@ class SequenceClient:
         self.pending.append((self.client_seq, kind))
         return self.client_seq
 
+    @staticmethod
+    def _op_handle(client_id: int, client_seq: int) -> tuple:
+        """Globally-unique, replica-invariant payload handle for one insert op
+        (same value computed at local apply and at every remote apply)."""
+        return (client_id * (2**26) + client_seq, 0)
+
     def insert_text_local(self, pos: int, text: str,
                           props: Optional[dict] = None) -> Dict[str, Any]:
         self._check_pos(pos)
@@ -53,6 +59,7 @@ class SequenceClient:
         self.tree.insert(
             pos, SegmentKind.TEXT, text, SEQ_UNASSIGNED, self.client_id,
             LOCAL_VIEW, props=props, local_op=self.client_seq,
+            handle=self._op_handle(self.client_id, self.client_seq),
         )
         op_id = self._record_pending("insert")
         return {"mt": "insert", "pos": pos, "kind": int(SegmentKind.TEXT),
@@ -65,6 +72,7 @@ class SequenceClient:
         self.tree.insert(
             pos, SegmentKind.MARKER, "", SEQ_UNASSIGNED, self.client_id,
             LOCAL_VIEW, props=props, local_op=self.client_seq,
+            handle=self._op_handle(self.client_id, self.client_seq),
         )
         op_id = self._record_pending("insert")
         return {"mt": "insert", "pos": pos, "kind": int(SegmentKind.MARKER),
@@ -126,6 +134,7 @@ class SequenceClient:
             self.tree.insert(
                 op["pos"], SegmentKind(op["kind"]), op["text"],
                 msg.seq, msg.client_id, msg.ref_seq, props=op.get("props"),
+                handle=self._op_handle(msg.client_id, op["clientSeq"]),
             )
         elif op["mt"] == "remove":
             self.tree.mark_range_removed(

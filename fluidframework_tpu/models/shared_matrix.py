@@ -1,0 +1,279 @@
+"""SharedMatrix: 2-D cells with collaborative row/col insert/remove.
+
+Reference counterpart: ``@fluidframework/matrix`` (``SharedMatrix``,
+``PermutationVector``, ``SparseArray2D``) — SURVEY.md §2.4 (mount empty).
+
+Architecture mirrors the reference's key idea: the row and column axes are
+*permutation vectors* — collaborative sequences whose elements are opaque
+row/col identities — so all the hard merge logic (concurrent insert/remove,
+perspectives, tie-breaks) is delegated to the same MergeTree that powers
+SharedString. A cell write op carries (row, col) *positions* plus the op's
+perspective; every replica resolves those positions through its permutation
+trees to a stable (rowKey, colKey) identity, and cell storage is a sparse map
+keyed by identities, LWW in sequence order (with the optional one-way switch
+to first-writer-wins, like the reference's ``switchSetCellPolicy``).
+
+Row/col identity = (opKey, offset): ``opKey`` is globally unique per insert op
+((client, per-client matrix op counter), carried in the op), ``offset`` is the
+index within that op's inserted run — stable across splits because MergeTree
+propagates ``handle`` through ``_split``.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.constants import SEQ_UNASSIGNED
+from ..core.protocol import SequencedDocumentMessage
+from .merge_tree import LOCAL_VIEW, MergeTree, SegmentKind
+from .shared_object import SharedObject
+
+Key = Tuple[int, int]  # (opKey encoding, offset within that insert op)
+
+
+class _Axis:
+    """One permutation vector (rows or cols) on a MergeTree."""
+
+    def __init__(self, client_id: int):
+        self.tree = MergeTree(client_id)
+        self.client_id = client_id
+
+    def length(self) -> int:
+        return self.tree.get_length()
+
+    def insert(self, pos: int, count: int, op_key: Tuple[int, int], seq: int,
+               client: int, ref_seq: int, local_op: Optional[int]) -> None:
+        seg = self.tree.insert(
+            pos, SegmentKind.TEXT, " " * count, seq, client, ref_seq,
+            local_op=local_op,
+        )
+        # encode identity through handle so splits keep (opKey, offset) stable
+        seg.handle = (op_key[0] * 1_000_003 + op_key[1], 0)
+
+    def remove(self, start: int, count: int, seq: int, client: int,
+               ref_seq: int, local_op: Optional[int]) -> None:
+        self.tree.mark_range_removed(start, start + count, seq, client,
+                                     ref_seq, local_op=local_op)
+
+    def resolve(self, pos: int, ref_seq: int, client: int) -> Key:
+        seg, off = self.tree.get_containing_segment(pos, ref_seq, client)
+        if seg is None:
+            raise IndexError(f"axis position {pos} out of range")
+        return (seg.handle[0], seg.handle[1] + off)
+
+
+class SharedMatrix(SharedObject):
+    TYPE = "matrix"
+
+    def __init__(self, object_id: str, client_id: int):
+        super().__init__(object_id, client_id)
+        self.rows = _Axis(client_id)
+        self.cols = _Axis(client_id)
+        # authoritative sequenced cell state: identical on every replica at
+        # the same seq point; pending local writes NEVER touch it (a discarded
+        # remote value could turn out to be the FWW winner after a mid-flight
+        # policy switch — found by matrix fuzz seed 16)
+        self.acked_cells: Dict[Tuple[Key, Key], Any] = {}
+        self.cell_seq: Dict[Tuple[Key, Key], int] = {}
+        self.cell_writer: Dict[Tuple[Key, Key], int] = {}
+        # optimistic overrides: cell -> latest in-flight local value
+        self._local_over: Dict[Tuple[Key, Key], Any] = {}
+        self._pending_cells: Dict[Tuple[Key, Key], int] = {}
+        self._op_counter = 0
+        self._pending: collections.deque = collections.deque()
+        self.fww = False  # one-way switch to first-writer-wins (reference parity)
+
+    # --------------------------------------------------------------- helpers
+
+    @property
+    def row_count(self) -> int:
+        return self.rows.length()
+
+    @property
+    def col_count(self) -> int:
+        return self.cols.length()
+
+    def _next_op(self, kind: str, meta=None) -> int:
+        # meta is the localOpMetadata of the reference (SURVEY.md §3.3): state
+        # resolved at submit time and replayed at ack, because re-resolving the
+        # op's perspective at ack time is poisoned by our own later pending ops
+        self._op_counter += 1
+        self._pending.append((self._op_counter, kind, meta))
+        return self._op_counter
+
+    # -------------------------------------------------------------- mutators
+
+    def insert_rows(self, pos: int, count: int) -> None:
+        if not 0 <= pos <= self.row_count or count <= 0:
+            raise IndexError(f"insert_rows({pos},{count}) invalid")
+        op_id = self._next_op("insRow")
+        key = (self.client_id, op_id)
+        self.rows.insert(pos, count, key, SEQ_UNASSIGNED, self.client_id,
+                         LOCAL_VIEW, local_op=op_id)
+        self.submit_local_message({"mx": "insRow", "pos": pos, "count": count,
+                                   "opKey": list(key), "clientSeq": op_id})
+
+    def insert_cols(self, pos: int, count: int) -> None:
+        if not 0 <= pos <= self.col_count or count <= 0:
+            raise IndexError(f"insert_cols({pos},{count}) invalid")
+        op_id = self._next_op("insCol")
+        key = (self.client_id, op_id)
+        self.cols.insert(pos, count, key, SEQ_UNASSIGNED, self.client_id,
+                         LOCAL_VIEW, local_op=op_id)
+        self.submit_local_message({"mx": "insCol", "pos": pos, "count": count,
+                                   "opKey": list(key), "clientSeq": op_id})
+
+    def remove_rows(self, start: int, count: int) -> None:
+        if not 0 <= start < start + count <= self.row_count:
+            raise IndexError(f"remove_rows({start},{count}) invalid")
+        op_id = self._next_op("rmRow")
+        self.rows.remove(start, count, SEQ_UNASSIGNED, self.client_id,
+                         LOCAL_VIEW, local_op=op_id)
+        self.submit_local_message({"mx": "rmRow", "start": start,
+                                   "count": count, "clientSeq": op_id})
+
+    def remove_cols(self, start: int, count: int) -> None:
+        if not 0 <= start < start + count <= self.col_count:
+            raise IndexError(f"remove_cols({start},{count}) invalid")
+        op_id = self._next_op("rmCol")
+        self.cols.remove(start, count, SEQ_UNASSIGNED, self.client_id,
+                         LOCAL_VIEW, local_op=op_id)
+        self.submit_local_message({"mx": "rmCol", "start": start,
+                                   "count": count, "clientSeq": op_id})
+
+    def set_cell(self, row: int, col: int, value: Any) -> None:
+        if not (0 <= row < self.row_count and 0 <= col < self.col_count):
+            raise IndexError(f"set_cell({row},{col}) outside "
+                             f"{self.row_count}x{self.col_count}")
+        rk = self.rows.resolve(row, LOCAL_VIEW, self.client_id)
+        ck = self.cols.resolve(col, LOCAL_VIEW, self.client_id)
+        op_id = self._next_op("setCell", meta=(rk, ck))
+        self._local_over[(rk, ck)] = value
+        self._pending_cells[(rk, ck)] = self._pending_cells.get((rk, ck), 0) + 1
+        self.submit_local_message({"mx": "setCell", "row": row, "col": col,
+                                   "value": value, "clientSeq": op_id})
+
+    def switch_set_cell_policy(self) -> None:
+        """One-way LWW -> first-writer-wins (reference: switchSetCellPolicy).
+
+        The flip takes effect when the op is *sequenced* (ack/remote apply),
+        never optimistically: otherwise the originator would judge ops
+        sequenced before the switch under FWW while everyone else still
+        applies LWW, diverging cell values."""
+        op_id = self._next_op("policy")
+        self.submit_local_message({"mx": "policy", "clientSeq": op_id})
+
+    # ----------------------------------------------------------------- reads
+
+    def get_cell(self, row: int, col: int) -> Any:
+        rk = self.rows.resolve(row, LOCAL_VIEW, self.client_id)
+        ck = self.cols.resolve(col, LOCAL_VIEW, self.client_id)
+        key = (rk, ck)
+        if key in self._local_over:
+            return self._local_over[key]
+        return self.acked_cells.get(key)
+
+    def to_lists(self) -> List[List[Any]]:
+        return [[self.get_cell(r, c) for c in range(self.col_count)]
+                for r in range(self.row_count)]
+
+    def digest(self) -> tuple:
+        return tuple(tuple(row) for row in self.to_lists())
+
+    # -------------------------------------------------------------- op inbox
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        op = msg.contents
+        kind = op["mx"]
+        if local:
+            op_id, pkind, meta = self._pending.popleft()
+            assert op_id == op["clientSeq"] and pkind == kind
+            self._ack(kind, op, msg, meta)
+            return
+        self._apply_remote(kind, op, msg)
+
+    def _ack(self, kind: str, op: dict, msg, meta) -> None:
+        if kind in ("insRow", "insCol"):
+            axis = self.rows if kind == "insRow" else self.cols
+            axis.tree.ack_insert(op["clientSeq"], msg.seq)
+        elif kind in ("rmRow", "rmCol"):
+            axis = self.rows if kind == "rmRow" else self.cols
+            axis.tree.ack_remove(op["clientSeq"], msg.seq)
+        elif kind == "setCell":
+            cell = meta
+            n = self._pending_cells.get(cell, 0) - 1
+            if n <= 0:
+                self._pending_cells.pop(cell, None)
+                self._local_over.pop(cell, None)  # reads fall back to acked
+            else:
+                self._pending_cells[cell] = n
+            if self._fww_rejects(cell, msg):
+                return  # our write lost under FWW; the winner stays acked
+            self.acked_cells[cell] = op["value"]
+            self.cell_seq[cell] = msg.seq
+            self.cell_writer[cell] = msg.client_id
+        elif kind == "policy":
+            self.fww = True
+
+    def _fww_rejects(self, cell, msg) -> bool:
+        """First-writer-wins rejection: the writer had not seen the current
+        value AND is not its author (a client always supersedes its own
+        earlier write — its ref_seq may predate it, but it authored it)."""
+        return (
+            self.fww
+            and self.cell_seq.get(cell, 0) > msg.ref_seq
+            and self.cell_writer.get(cell) != msg.client_id
+        )
+
+    def _apply_remote(self, kind: str, op: dict, msg) -> None:
+        if kind in ("insRow", "insCol"):
+            axis = self.rows if kind == "insRow" else self.cols
+            axis.insert(op["pos"], op["count"], tuple(op["opKey"]), msg.seq,
+                        msg.client_id, msg.ref_seq, local_op=None)
+        elif kind in ("rmRow", "rmCol"):
+            axis = self.rows if kind == "rmRow" else self.cols
+            axis.remove(op["start"], op["count"], msg.seq, msg.client_id,
+                        msg.ref_seq, local_op=None)
+        elif kind == "setCell":
+            rk = self.rows.resolve(op["row"], msg.ref_seq, msg.client_id)
+            ck = self.cols.resolve(op["col"], msg.ref_seq, msg.client_id)
+            cell = (rk, ck)
+            if self._fww_rejects(cell, msg):
+                return
+            # acked state applies unconditionally; in-flight local writes only
+            # shadow *reads* (the override layer), never the sequenced state
+            self.acked_cells[cell] = op["value"]
+            self.cell_seq[cell] = msg.seq
+            self.cell_writer[cell] = msg.client_id
+        elif kind == "policy":
+            self.fww = True
+        else:
+            raise ValueError(f"unknown matrix op {kind!r}")
+
+    def on_min_seq(self, min_seq: int) -> None:
+        for axis in (self.rows, self.cols):
+            if min_seq > axis.tree.min_seq:
+                axis.tree.zamboni(min_seq)
+
+    # ------------------------------------------------------------- summaries
+
+    def summarize(self) -> dict:
+        grid = self.to_lists()
+        return {"type": self.TYPE, "rows": self.row_count,
+                "cols": self.col_count, "grid": grid, "fww": self.fww}
+
+    def load_core(self, summary: dict) -> None:
+        r, c = summary["rows"], summary["cols"]
+        self.fww = summary.get("fww", False)
+        if r:
+            self.rows.insert(0, r, (0, 1), 0, -1, 0, None)
+        if c:
+            self.cols.insert(0, c, (0, 2), 0, -1, 0, None)
+        for i in range(r):
+            for j in range(c):
+                v = summary["grid"][i][j]
+                if v is not None:
+                    rk = self.rows.resolve(i, LOCAL_VIEW, self.client_id)
+                    ck = self.cols.resolve(j, LOCAL_VIEW, self.client_id)
+                    self.acked_cells[(rk, ck)] = v

@@ -1,0 +1,125 @@
+"""SharedObject base plumbing + the channel factory plugin boundary.
+
+Reference counterpart: ``@fluidframework/shared-object-base``
+(``SharedObject``, ``process``/``submitLocalMessage``, attach/summarize
+lifecycle) and the ``IChannelFactory``/``IChannel`` contracts in
+``datastore-definitions`` — SURVEY.md §2.7 (mount empty). This registry is the
+boundary the north star names: the tensorized merge-tree channel registers here
+exactly like any other DDS.
+
+A SharedObject is one replica of one distributed data structure. It can be
+wired directly to a ``MockSequencer`` (tests), or routed through the container
+runtime / datastore addressing (``runtime/``), which sets ``_submit_fn``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+
+
+class SharedObject:
+    """Base class for every DDS replica (reference: SharedObjectCore)."""
+
+    # subclasses set this to their channel type, e.g.
+    # "https://graph.microsoft.com/types/map"-style identifiers in the
+    # reference; short stable strings here.
+    TYPE: str = "base"
+
+    def __init__(self, object_id: str, client_id: int):
+        self.id = object_id
+        self.client_id = client_id
+        self.last_processed_seq = 0
+        self._submit_fn: Optional[Callable[[dict], None]] = None
+        self._attached = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def connect(self, submit_fn: Callable[[dict], None]) -> None:
+        """Attach to an op channel; pending local state is (re)submitted by
+        the runtime layer on reconnect, not here."""
+        self._submit_fn = submit_fn
+        self._attached = True
+
+    def submit_local_message(self, contents: dict) -> None:
+        if self._submit_fn is not None:
+            self._submit_fn(contents)
+
+    # -------------------------------------------------------------- op inbox
+
+    def apply_msg(self, msg: SequencedDocumentMessage) -> None:
+        """Process one sequenced op (reference: SharedObject.process)."""
+        assert msg.seq > self.last_processed_seq, "ops must arrive in seq order"
+        addressed_here = msg.address is None or msg.address == self.id
+        if msg.type == MessageType.OP and msg.contents is not None \
+                and addressed_here:
+            self.process_core(msg, local=msg.client_id == self.client_id)
+        self.last_processed_seq = msg.seq
+        self.on_min_seq(msg.min_seq)
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        raise NotImplementedError
+
+    def on_min_seq(self, min_seq: int) -> None:
+        """Collaboration-window advance hook (zamboni etc.)."""
+
+    # ------------------------------------------------------------- summaries
+
+    def summarize(self) -> dict:
+        raise NotImplementedError
+
+    def load_core(self, summary: dict) -> None:
+        raise NotImplementedError
+
+
+class ChannelFactory:
+    """Creates/loads one DDS type (reference: IChannelFactory)."""
+
+    def __init__(self, type_name: str, cls):
+        self.type = type_name
+        self.cls = cls
+
+    def create(self, object_id: str, client_id: int) -> SharedObject:
+        return self.cls(object_id, client_id)
+
+    def load(self, object_id: str, client_id: int, summary: dict) -> SharedObject:
+        obj = self.cls(object_id, client_id)
+        obj.load_core(summary)
+        return obj
+
+
+class ChannelRegistry:
+    """The DDS plugin boundary (reference: ISharedObjectRegistry)."""
+
+    def __init__(self):
+        self._factories: Dict[str, ChannelFactory] = {}
+
+    def register(self, factory: ChannelFactory) -> None:
+        self._factories[factory.type] = factory
+
+    def get(self, type_name: str) -> ChannelFactory:
+        if type_name not in self._factories:
+            raise KeyError(f"no channel factory registered for {type_name!r}")
+        return self._factories[type_name]
+
+    def types(self):
+        return sorted(self._factories)
+
+
+def default_registry() -> ChannelRegistry:
+    """Registry with every built-in DDS type registered."""
+    from .shared_map import SharedMap, SharedDirectory
+    from .shared_string import SharedString
+    from .shared_matrix import SharedMatrix
+    from .small_dds import (
+        SharedCounter, SharedCell, RegisterCollection,
+        ConsensusQueue, TaskManager,
+    )
+
+    reg = ChannelRegistry()
+    for cls in (SharedMap, SharedDirectory, SharedString, SharedMatrix,
+                SharedCounter, SharedCell, RegisterCollection,
+                ConsensusQueue, TaskManager):
+        reg.register(ChannelFactory(cls.TYPE, cls))
+    return reg

@@ -1,0 +1,243 @@
+"""Small op-based DDSes: counter, cell, register collection, consensus queue,
+task manager.
+
+Reference counterparts (SURVEY.md §2.5; mount empty):
+``@fluidframework/counter`` (SharedCounter), ``cell`` (SharedCell),
+``register-collection`` (ConsensusRegisterCollection),
+``ordered-collection`` (ConsensusQueue), ``task-manager`` (TaskManager).
+Each is a thin op protocol over the total order; together they exercise every
+op-semantics pattern the big DDSes use (commutative apply, LWW shadowing,
+version supersession, sequencing-as-consensus).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Dict, List, Optional
+
+from ..core.protocol import SequencedDocumentMessage
+from .shared_object import SharedObject
+
+
+class SharedCounter(SharedObject):
+    """Monotone-merge counter: increments commute, so every replica applies
+    every increment exactly once (local ones optimistically at submit)."""
+
+    TYPE = "counter"
+
+    def __init__(self, object_id: str, client_id: int):
+        super().__init__(object_id, client_id)
+        self.value = 0
+
+    def increment(self, delta: int = 1) -> None:
+        self.value += delta
+        self.submit_local_message({"op": "incr", "delta": delta})
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        if not local:  # local increments were applied at submit
+            self.value += msg.contents["delta"]
+
+    def summarize(self) -> dict:
+        return {"type": self.TYPE, "value": self.value}
+
+    def load_core(self, summary: dict) -> None:
+        self.value = summary["value"]
+
+
+class SharedCell(SharedObject):
+    """Single LWW value with in-flight local shadowing (a one-key SharedMap)."""
+
+    TYPE = "cell"
+    _EMPTY = object()
+
+    def __init__(self, object_id: str, client_id: int):
+        super().__init__(object_id, client_id)
+        self._value: Any = self._EMPTY
+        self._pending = 0
+
+    def set(self, value: Any) -> None:
+        self._value = value
+        self._pending += 1
+        self.submit_local_message({"op": "set", "value": value})
+
+    def delete(self) -> None:
+        self._value = self._EMPTY
+        self._pending += 1
+        self.submit_local_message({"op": "delete"})
+
+    def get(self) -> Any:
+        return None if self._value is self._EMPTY else self._value
+
+    def empty(self) -> bool:
+        return self._value is self._EMPTY
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        if local:
+            self._pending -= 1
+            return
+        if self._pending > 0:
+            return  # our later-sequenced write wins
+        op = msg.contents
+        self._value = op["value"] if op["op"] == "set" else self._EMPTY
+
+    def summarize(self) -> dict:
+        return {"type": self.TYPE,
+                "value": None if self._value is self._EMPTY else self._value,
+                "empty": self._value is self._EMPTY}
+
+    def load_core(self, summary: dict) -> None:
+        self._value = self._EMPTY if summary["empty"] else summary["value"]
+
+
+class RegisterCollection(SharedObject):
+    """Versioned LWW registers: a write supersedes exactly the versions its
+    client had seen (seq <= refSeq); concurrent writes coexist as versions.
+    ``read`` returns the atomic (earliest surviving) version.
+
+    Reference: ConsensusRegisterCollection. Writes are not optimistic — the
+    value lands when the op is sequenced, on every replica including the
+    writer (consensus semantics, unlike SharedMap)."""
+
+    TYPE = "registerCollection"
+
+    def __init__(self, object_id: str, client_id: int):
+        super().__init__(object_id, client_id)
+        self.versions: Dict[str, List[tuple]] = {}  # key -> [(value, seq)]
+
+    def write(self, key: str, value: Any) -> None:
+        self.submit_local_message({"op": "write", "key": key, "value": value})
+
+    def read(self, key: str) -> Any:
+        v = self.versions.get(key)
+        return v[0][0] if v else None
+
+    def read_versions(self, key: str) -> List[Any]:
+        return [val for val, _ in self.versions.get(key, [])]
+
+    def keys(self):
+        return sorted(self.versions)
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        op = msg.contents
+        key = op["key"]
+        kept = [(v, s) for v, s in self.versions.get(key, [])
+                if s > msg.ref_seq]
+        kept.append((op["value"], msg.seq))
+        self.versions[key] = kept
+
+    def summarize(self) -> dict:
+        return {"type": self.TYPE,
+                "versions": {k: [[v, s] for v, s in vs]
+                             for k, vs in self.versions.items()}}
+
+    def load_core(self, summary: dict) -> None:
+        self.versions = {k: [tuple(e) for e in vs]
+                         for k, vs in summary["versions"].items()}
+
+
+class ConsensusQueue(SharedObject):
+    """Distributed work queue where sequencing IS the consensus: an acquire op
+    deterministically assigns the head item to its submitting client on every
+    replica (reference: ConsensusOrderedCollection acquire/release/complete)."""
+
+    TYPE = "consensusQueue"
+
+    def __init__(self, object_id: str, client_id: int):
+        super().__init__(object_id, client_id)
+        self.items: collections.deque = collections.deque()
+        self.acquired: Dict[str, tuple] = {}  # acquireId -> (client, value)
+        self._acq_counter = 0
+
+    def add(self, value: Any) -> None:
+        self.submit_local_message({"op": "add", "value": value})
+
+    def acquire(self) -> str:
+        """Request the head item; returns the acquire id to poll after
+        sequencing (the op may find the queue empty)."""
+        self._acq_counter += 1
+        acq_id = f"acq-{self.client_id}-{self._acq_counter}"
+        self.submit_local_message({"op": "acquire", "id": acq_id})
+        return acq_id
+
+    def complete(self, acq_id: str) -> None:
+        self.submit_local_message({"op": "complete", "id": acq_id})
+
+    def release(self, acq_id: str) -> None:
+        self.submit_local_message({"op": "release", "id": acq_id})
+
+    def result(self, acq_id: str) -> Optional[Any]:
+        entry = self.acquired.get(acq_id)
+        return entry[1] if entry else None
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        op = msg.contents
+        kind = op["op"]
+        if kind == "add":
+            self.items.append(op["value"])
+        elif kind == "acquire":
+            if self.items:
+                self.acquired[op["id"]] = (msg.client_id, self.items.popleft())
+        elif kind == "complete":
+            self.acquired.pop(op["id"], None)
+        elif kind == "release":
+            entry = self.acquired.pop(op["id"], None)
+            if entry is not None:
+                self.items.appendleft(entry[1])
+
+    def summarize(self) -> dict:
+        return {"type": self.TYPE, "items": list(self.items),
+                "acquired": {k: list(v) for k, v in self.acquired.items()}}
+
+    def load_core(self, summary: dict) -> None:
+        self.items = collections.deque(summary["items"])
+        self.acquired = {k: tuple(v) for k, v in summary["acquired"].items()}
+
+
+class TaskManager(SharedObject):
+    """Cooperative task locking: volunteers queue per task id in sequence
+    order; the queue head holds the lock (reference: TaskManager
+    volunteerForTask/abandonTask, used for summarizer election patterns)."""
+
+    TYPE = "taskManager"
+
+    def __init__(self, object_id: str, client_id: int):
+        super().__init__(object_id, client_id)
+        self.queues: Dict[str, List[int]] = {}
+
+    def volunteer(self, task_id: str) -> None:
+        self.submit_local_message({"op": "volunteer", "task": task_id})
+
+    def abandon(self, task_id: str) -> None:
+        self.submit_local_message({"op": "abandon", "task": task_id})
+
+    def assigned_to(self, task_id: str) -> Optional[int]:
+        q = self.queues.get(task_id)
+        return q[0] if q else None
+
+    def have_task(self, task_id: str) -> bool:
+        return self.assigned_to(task_id) == self.client_id
+
+    def queued(self, task_id: str) -> List[int]:
+        return list(self.queues.get(task_id, []))
+
+    def handle_client_leave(self, client_id: int) -> None:
+        """Quorum-integration hook: a departed client forfeits its spots."""
+        for q in self.queues.values():
+            while client_id in q:
+                q.remove(client_id)
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        op = msg.contents
+        q = self.queues.setdefault(op["task"], [])
+        if op["op"] == "volunteer":
+            if msg.client_id not in q:
+                q.append(msg.client_id)
+        elif op["op"] == "abandon":
+            if msg.client_id in q:
+                q.remove(msg.client_id)
+
+    def summarize(self) -> dict:
+        return {"type": self.TYPE, "queues": dict(self.queues)}
+
+    def load_core(self, summary: dict) -> None:
+        self.queues = {k: list(v) for k, v in summary["queues"].items()}

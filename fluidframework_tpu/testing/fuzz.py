@@ -71,6 +71,124 @@ def run_sequence_fuzz(
     return clients
 
 
+def run_map_fuzz(seed: int, n_clients: int = 3, n_rounds: int = 30,
+                 ops_per_round: int = 5):
+    """Random set/delete/clear storm on SharedMap replicas."""
+    from ..models.shared_map import SharedMap
+    from .mocks import create_connected_dds
+
+    rng = random.Random(seed)
+    seqr = MockSequencer()
+    maps = [create_connected_dds(seqr, SharedMap) for _ in range(n_clients)]
+    keys = [f"k{i}" for i in range(8)]
+    for _ in range(n_rounds):
+        for _ in range(ops_per_round):
+            m = rng.choice(maps)
+            roll = rng.random()
+            if roll < 0.7:
+                m.set(rng.choice(keys), rng.randint(0, 99))
+            elif roll < 0.95:
+                m.delete(rng.choice(keys))
+            else:
+                m.clear()
+        seqr.process_some(rng.randint(0, seqr.outstanding))
+    seqr.process_all_messages()
+    states = {tuple(m.items()) for m in maps}
+    assert len(states) == 1, f"SharedMap divergence: {states}"
+    return maps
+
+
+def run_matrix_fuzz(seed: int, n_clients: int = 3, n_rounds: int = 20,
+                    ops_per_round: int = 4):
+    """Random row/col insert/remove + cell-set storm on SharedMatrix."""
+    from ..models.shared_matrix import SharedMatrix
+    from .mocks import create_connected_dds
+
+    rng = random.Random(seed)
+    seqr = MockSequencer()
+    mats = [create_connected_dds(seqr, SharedMatrix) for _ in range(n_clients)]
+    for _ in range(n_rounds):
+        for _ in range(ops_per_round):
+            m = rng.choice(mats)
+            roll = rng.random()
+            r, c = m.row_count, m.col_count
+            if r == 0 or c == 0 or roll < 0.25:
+                if rng.random() < 0.5:
+                    m.insert_rows(rng.randint(0, r), rng.randint(1, 2))
+                else:
+                    m.insert_cols(rng.randint(0, c), rng.randint(1, 2))
+            elif roll < 0.35 and r > 1:
+                start = rng.randint(0, r - 1)
+                m.remove_rows(start, rng.randint(1, min(2, r - start)))
+            elif roll < 0.42 and c > 1:
+                start = rng.randint(0, c - 1)
+                m.remove_cols(start, rng.randint(1, min(2, c - start)))
+            elif roll < 0.44 and not m.fww:
+                m.switch_set_cell_policy()  # mid-flight LWW -> FWW switch
+            else:
+                m.set_cell(rng.randrange(r), rng.randrange(c),
+                           rng.randint(0, 99))
+        seqr.process_some(rng.randint(0, seqr.outstanding))
+        if rng.random() < 0.3:
+            seqr.submit(rng.choice(mats), {}, type=MessageType.NOOP)
+    seqr.process_all_messages()
+    digests = {m.digest() for m in mats}
+    assert len(digests) == 1, "SharedMatrix divergence"
+    return mats
+
+
+def run_string_channel_fuzz(seed: int, n_clients: int = 3, n_rounds: int = 20,
+                            ops_per_round: int = 4):
+    """SharedString channel fuzz: text edits + interval add/change/delete."""
+    from ..models.shared_string import SharedString
+    from .mocks import create_connected_dds
+
+    rng = random.Random(seed)
+    seqr = MockSequencer()
+    strs = [create_connected_dds(seqr, SharedString) for _ in range(n_clients)]
+    iv_ids: List[str] = []
+    for _ in range(n_rounds):
+        for _ in range(ops_per_round):
+            s = rng.choice(strs)
+            n = s.get_length()
+            roll = rng.random()
+            if n == 0 or roll < 0.5:
+                s.insert_text(rng.randint(0, n), _rand_text(rng))
+            elif roll < 0.65 and n > 0:
+                start = rng.randint(0, n - 1)
+                s.remove_text(start, rng.randint(start + 1, min(n, start + 6)))
+            elif roll < 0.8 and n > 1:
+                coll = s.get_interval_collection("fuzz")
+                start = rng.randint(0, n - 2)
+                iv_ids.append(coll.add(start, rng.randint(start, n - 1)))
+            elif iv_ids:
+                coll = s.get_interval_collection("fuzz")
+                iid = rng.choice(iv_ids)
+                sub = rng.random()
+                if sub < 0.2 and n > 1:     # start-only change
+                    coll.change(iid, start=rng.randint(0, n - 2))
+                elif sub < 0.4 and n > 1:   # end-only change
+                    coll.change(iid, end=rng.randint(0, n - 1))
+                elif sub < 0.5:             # props-only change
+                    coll.change(iid, props={rng.choice("xyz"):
+                                            rng.choice([1, 2, None])})
+                elif sub < 0.75 and n > 1:  # full change
+                    start = rng.randint(0, n - 2)
+                    coll.change(iid, start=start,
+                                end=rng.randint(start, n - 1))
+                else:
+                    coll.delete(iid)
+        seqr.process_some(rng.randint(0, seqr.outstanding))
+        if rng.random() < 0.3:
+            seqr.submit(rng.choice(strs), {}, type=MessageType.NOOP)
+    seqr.process_all_messages()
+    texts = {s.get_text() for s in strs}
+    assert len(texts) == 1, f"text divergence: {texts}"
+    digs = {s.get_interval_collection("fuzz").digest() for s in strs}
+    assert len(digs) == 1, "interval divergence"
+    return strs
+
+
 def assert_converged(clients: List[SequenceClient]) -> None:
     texts = {c.get_text() for c in clients}
     assert len(texts) == 1, f"replica text divergence: {texts}"

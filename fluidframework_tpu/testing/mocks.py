@@ -62,6 +62,7 @@ class MockSequencer:
             ref_seq=replica.last_processed_seq,
             type=type,
             contents=contents,
+            address=getattr(replica, "id", None),
         ))
 
     @property
@@ -90,6 +91,7 @@ class MockSequencer:
             min_seq=self._min_seq(),
             type=raw["type"],
             contents=raw["contents"],
+            address=raw.get("address"),
         )
         for replica in list(self._replicas):
             replica.apply_msg(msg)
@@ -105,3 +107,12 @@ class MockSequencer:
 
     def process_all_messages(self) -> int:
         return self.process_some(len(self._queue))
+
+
+def create_connected_dds(seqr: MockSequencer, cls, object_id: str = "dds"):
+    """One replica of ``cls`` wired to the mock sequencer (the
+    MockFluidDataStoreRuntime-style shortcut for DDS-level tests)."""
+    obj = cls(object_id, seqr.allocate_client_id())
+    seqr.connect(obj)
+    obj.connect(lambda contents: seqr.submit(obj, contents))
+    return obj

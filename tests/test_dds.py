@@ -1,0 +1,297 @@
+"""DDS-level semantics tests: map, directory, matrix, string+intervals, and
+the small DDSes — multi-replica via the mock sequencer (SURVEY.md §4 pattern).
+"""
+
+import pytest
+
+from fluidframework_tpu.models import (
+    SharedMap, SharedDirectory, SharedMatrix, SharedString, SharedCounter,
+    SharedCell, RegisterCollection, ConsensusQueue, TaskManager,
+    default_registry,
+)
+from fluidframework_tpu.testing.mocks import MockSequencer, create_connected_dds
+
+
+def pair(cls):
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, cls)
+    b = create_connected_dds(seqr, cls)
+    return seqr, a, b
+
+
+# ------------------------------------------------------------------ SharedMap
+
+def test_map_set_get_converges():
+    seqr, a, b = pair(SharedMap)
+    a.set("x", 1)
+    assert a.get("x") == 1          # optimistic local
+    assert b.get("x") is None
+    seqr.process_all_messages()
+    assert b.get("x") == 1
+
+
+def test_map_concurrent_set_last_sequenced_wins():
+    seqr, a, b = pair(SharedMap)
+    a.set("k", "from-a")
+    b.set("k", "from-b")            # submitted second -> sequenced later
+    seqr.process_all_messages()
+    assert a.get("k") == b.get("k") == "from-b"
+
+
+def test_map_pending_local_shadows_remote():
+    seqr, a, b = pair(SharedMap)
+    b.set("k", "remote")
+    a.set("k", "local")             # a's op sequenced after b's
+    seqr.process_some(1)            # only b's op arrives at a
+    assert a.get("k") == "local"    # a never flickers to "remote"
+    seqr.process_all_messages()
+    assert a.get("k") == b.get("k") == "local"
+
+
+def test_map_clear_vs_concurrent_set():
+    seqr, a, b = pair(SharedMap)
+    a.set("x", 1)
+    seqr.process_all_messages()
+    a.clear()
+    b.set("y", 2)                   # sequenced after the clear -> survives
+    seqr.process_all_messages()
+    assert dict(a.items()) == dict(b.items()) == {"y": 2}
+
+
+def test_map_delete_and_summary():
+    seqr, a, b = pair(SharedMap)
+    a.set("x", 1)
+    a.set("y", [1, 2])
+    a.delete("x")
+    seqr.process_all_messages()
+    summary = b.summarize()
+    c = SharedMap("dds", 99)
+    c.load_core(summary)
+    assert c.get("y") == [1, 2] and not c.has("x")
+
+
+# ------------------------------------------------------------ SharedDirectory
+
+def test_directory_subdirs_and_keys():
+    seqr, a, b = pair(SharedDirectory)
+    a.create_sub_directory("/users/alice")
+    a.set("role", "admin", path="/users/alice")
+    a.set("top", 1)
+    seqr.process_all_messages()
+    assert b.get("role", path="/users/alice") == "admin"
+    assert b.get("top") == 1
+    assert "/users/alice/" in b.subdirectories()
+
+
+# --------------------------------------------------------------- SharedMatrix
+
+def test_matrix_basic_cells():
+    seqr, a, b = pair(SharedMatrix)
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 3)
+    seqr.process_all_messages()
+    a.set_cell(0, 1, "x")
+    b.set_cell(1, 2, "y")
+    seqr.process_all_messages()
+    assert a.to_lists() == b.to_lists() == [[None, "x", None],
+                                            [None, None, "y"]]
+
+
+def test_matrix_concurrent_row_insert_converges():
+    seqr, a, b = pair(SharedMatrix)
+    a.insert_rows(0, 1)
+    a.insert_cols(0, 1)
+    seqr.process_all_messages()
+    a.set_cell(0, 0, "base")
+    seqr.process_all_messages()
+    a.insert_rows(0, 1)            # both insert at row 0 concurrently
+    b.insert_rows(0, 1)
+    seqr.process_all_messages()
+    assert a.row_count == b.row_count == 3
+    assert a.to_lists() == b.to_lists()
+    # the original cell still reads "base" at its (moved) position
+    assert "base" in [c for row in a.to_lists() for c in row]
+
+
+def test_matrix_cell_on_concurrently_moved_row():
+    seqr, a, b = pair(SharedMatrix)
+    a.insert_rows(0, 3)
+    a.insert_cols(0, 1)
+    seqr.process_all_messages()
+    # b writes to row 2 while a inserts a row above it: the write must land
+    # on the same logical row after the insert shifts positions
+    b.set_cell(2, 0, "target")
+    a.insert_rows(0, 1)
+    seqr.process_all_messages()
+    assert a.to_lists() == b.to_lists()
+    assert a.get_cell(3, 0) == "target"
+
+
+def test_matrix_remove_rows_and_lww():
+    seqr, a, b = pair(SharedMatrix)
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 2)
+    seqr.process_all_messages()
+    a.set_cell(0, 0, 1)
+    b.set_cell(0, 0, 2)            # sequenced later -> wins
+    seqr.process_all_messages()
+    assert a.get_cell(0, 0) == b.get_cell(0, 0) == 2
+    a.remove_rows(0, 1)
+    seqr.process_all_messages()
+    assert a.row_count == b.row_count == 1
+    assert a.to_lists() == b.to_lists()
+
+
+def test_interval_partial_changes_merge_per_field():
+    # regression: an in-flight start-only local change must NOT swallow an
+    # earlier-sequenced remote end-only change (per-field shadowing)
+    seqr, a, b = pair(SharedString)
+    a.insert_text(0, "abcdefgh")
+    seqr.process_all_messages()
+    iid = a.get_interval_collection("c").add(1, 3)
+    seqr.process_all_messages()
+    b.get_interval_collection("c").change(iid, end=6)    # sequenced first
+    a.get_interval_collection("c").change(iid, start=2)  # in flight at a
+    seqr.process_all_messages()
+    ca, cb = a.get_interval_collection("c"), b.get_interval_collection("c")
+    assert ca.endpoints(iid) == cb.endpoints(iid) == (2, 6)
+
+
+def test_matrix_fww_switch_not_optimistic():
+    # regression: the policy flip must take effect at sequencing time, not at
+    # submit — otherwise the originator judges pre-switch ops under FWW
+    seqr, a, b = pair(SharedMatrix)
+    a.insert_rows(0, 1)
+    a.insert_cols(0, 1)
+    seqr.process_all_messages()
+    a.set_cell(0, 0, "W1")
+    seqr.process_all_messages()
+    b.set_cell(0, 0, "W2")           # sequenced before the switch: LWW, wins
+    a.switch_set_cell_policy()
+    seqr.process_all_messages()
+    assert a.get_cell(0, 0) == b.get_cell(0, 0) == "W2"
+    assert a.fww and b.fww
+
+
+def test_matrix_fww_policy():
+    seqr, a, b = pair(SharedMatrix)
+    a.insert_rows(0, 1)
+    a.insert_cols(0, 1)
+    a.switch_set_cell_policy()
+    seqr.process_all_messages()
+    a.set_cell(0, 0, "first")      # sequenced first -> wins under FWW
+    b.set_cell(0, 0, "second")
+    seqr.process_all_messages()
+    assert a.get_cell(0, 0) == b.get_cell(0, 0) == "first"
+
+
+# ------------------------------------------------- SharedString + intervals
+
+def test_shared_string_channel_and_intervals():
+    seqr, a, b = pair(SharedString)
+    a.insert_text(0, "hello world")
+    seqr.process_all_messages()
+    ivs_a = a.get_interval_collection("comments")
+    iid = ivs_a.add(6, 10, {"author": "a"})     # over "world"
+    seqr.process_all_messages()
+    ivs_b = b.get_interval_collection("comments")
+    assert ivs_b.endpoints(iid) == (6, 10)
+    # remote edit before the interval shifts it on every replica
+    b.insert_text(0, ">> ")
+    seqr.process_all_messages()
+    assert ivs_a.endpoints(iid) == ivs_b.endpoints(iid) == (9, 13)
+    assert ivs_a.digest() == ivs_b.digest()
+    # overlapping query
+    assert [iv.interval_id for iv in ivs_a.find_overlapping(10, 11)] == [iid]
+
+
+def test_interval_change_and_delete_converge():
+    seqr, a, b = pair(SharedString)
+    a.insert_text(0, "abcdefgh")
+    seqr.process_all_messages()
+    iv1 = a.get_interval_collection("c").add(1, 3)
+    iv2 = a.get_interval_collection("c").add(4, 6)
+    seqr.process_all_messages()
+    a.get_interval_collection("c").change(iv1, start=0, end=2)
+    b.get_interval_collection("c").delete(iv2)
+    seqr.process_all_messages()
+    ca, cb = a.get_interval_collection("c"), b.get_interval_collection("c")
+    assert ca.digest() == cb.digest()
+    assert ca.endpoints(iv1) == (0, 2) and ca.get(iv2) is None
+
+
+# ---------------------------------------------------------------- small DDSes
+
+def test_counter_commutative_increments():
+    seqr, a, b = pair(SharedCounter)
+    a.increment(5)
+    b.increment(-2)
+    assert a.value == 5 and b.value == -2   # optimistic
+    seqr.process_all_messages()
+    assert a.value == b.value == 3
+
+
+def test_cell_lww_with_shadow():
+    seqr, a, b = pair(SharedCell)
+    b.set("old")
+    a.set("new")                    # sequenced later
+    seqr.process_all_messages()
+    assert a.get() == b.get() == "new"
+    a.delete()
+    seqr.process_all_messages()
+    assert a.empty() and b.empty()
+
+
+def test_register_collection_concurrent_versions():
+    seqr, a, b = pair(RegisterCollection)
+    a.write("k", "va")
+    b.write("k", "vb")              # concurrent: neither saw the other
+    seqr.process_all_messages()
+    # both versions survive; atomic read = earliest sequenced
+    assert a.read("k") == b.read("k") == "va"
+    assert a.read_versions("k") == b.read_versions("k") == ["va", "vb"]
+    a.write("k", "final")           # supersedes both (a has seen them)
+    seqr.process_all_messages()
+    assert b.read_versions("k") == ["final"]
+
+
+def test_consensus_queue_single_winner():
+    seqr, a, b = pair(ConsensusQueue)
+    a.add("job1")
+    seqr.process_all_messages()
+    ra = a.acquire()
+    rb = b.acquire()                # sequenced second: queue already empty
+    seqr.process_all_messages()
+    assert a.result(ra) == "job1" and b.result(rb) is None
+    # release puts it back for the other client
+    a.release(ra)
+    seqr.process_all_messages()
+    rb2 = b.acquire()
+    seqr.process_all_messages()
+    assert b.result(rb2) == "job1"
+    b.complete(rb2)
+    seqr.process_all_messages()
+    assert not a.acquired and not b.acquired
+
+
+def test_task_manager_lock_queue():
+    seqr, a, b = pair(TaskManager)
+    a.volunteer("summarizer")
+    b.volunteer("summarizer")
+    seqr.process_all_messages()
+    assert a.assigned_to("summarizer") == b.assigned_to("summarizer") == a.client_id
+    assert a.have_task("summarizer") and not b.have_task("summarizer")
+    a.abandon("summarizer")
+    seqr.process_all_messages()
+    assert b.have_task("summarizer")
+
+
+# ------------------------------------------------------------------- registry
+
+def test_channel_registry_creates_all_types():
+    reg = default_registry()
+    assert set(reg.types()) >= {"map", "directory", "sharedString", "matrix",
+                                "counter", "cell", "registerCollection",
+                                "consensusQueue", "taskManager"}
+    obj = reg.get("map").create("m1", 7)
+    assert isinstance(obj, SharedMap) and obj.id == "m1"

@@ -1,0 +1,24 @@
+"""Convergence fuzz for SharedMap, SharedMatrix, and the SharedString channel
+(text + interval collections), per the reference's DDS-fuzz strategy
+(SURVEY.md §4)."""
+
+import pytest
+
+from fluidframework_tpu.testing.fuzz import (
+    run_map_fuzz, run_matrix_fuzz, run_string_channel_fuzz,
+)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_map_fuzz(seed):
+    run_map_fuzz(seed)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_matrix_fuzz(seed):
+    run_matrix_fuzz(seed)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_string_channel_fuzz(seed):
+    run_string_channel_fuzz(seed)
