@@ -1,0 +1,79 @@
+"""Headline benchmark: SharedString ops/sec merged across a 10k-doc batch.
+
+BASELINE.md config #4 (Deli replay across many docs, the north-star metric):
+a synthetic multi-doc typing storm is sequenced round-robin and merged by the
+batched merge-tree kernel on the real chip, with zamboni compaction between
+batches. Prints ONE JSON line; vs_baseline is against the 1M ops/sec target
+(no published reference numbers exist — BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.merge_tree_kernel import (
+        StringState, apply_string_batch, compact_string_state,
+    )
+    from fluidframework_tpu.testing.synthetic import typing_storm
+
+    n_docs = 8192
+    capacity = 1024
+    ops_per_batch = 64
+    n_batches = 4
+    order = ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")
+
+    batches = []
+    seq = 1
+    for b in range(n_batches):
+        planes, seq = typing_storm(n_docs, ops_per_batch, seed=b,
+                                   start_seq=seq)
+        batches.append(tuple(jnp.asarray(planes[k]) for k in order))
+
+    apply_fn = jax.jit(apply_string_batch, donate_argnums=0)
+    compact_fn = jax.jit(compact_string_state, donate_argnums=0)
+
+    # warmup / compile on a throwaway state
+    state = StringState.create(n_docs, capacity)
+    state = apply_fn(state, *batches[0])
+    state = compact_fn(state, jnp.zeros((n_docs,), jnp.int32))
+    jax.block_until_ready(state)
+
+    state = StringState.create(n_docs, capacity)
+    lat = []
+    t0 = time.perf_counter()
+    done_seq = 0
+    for b, batch in enumerate(batches):
+        tb = time.perf_counter()
+        state = apply_fn(state, *batch)
+        done_seq += n_docs * ops_per_batch
+        state = compact_fn(state,
+                           jnp.full((n_docs,), done_seq, jnp.int32))
+        jax.block_until_ready(state)
+        lat.append(time.perf_counter() - tb)
+    total = time.perf_counter() - t0
+
+    assert not np.asarray(state.overflow).any(), "capacity overflow in bench"
+    n_ops = n_docs * ops_per_batch * n_batches
+    ops_per_sec = n_ops / total
+    batch_p99_ms = float(np.percentile(lat, 99) * 1000)
+
+    print(json.dumps({
+        "metric": "sharedstring_ops_per_sec_merged",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / 1_000_000, 4),
+        "docs": n_docs,
+        "total_ops": n_ops,
+        "batch_p99_ms": round(batch_p99_ms, 2),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,15 +40,20 @@ class IntervalCollection:
         seg, offset = self.tree.get_containing_segment(pos, ref_seq, client)
         if seg is None:
             # endpoint at (or beyond) doc end in this perspective: anchor to
-            # the last segment visible in that perspective
+            # the last segment visible in that perspective; failing that, the
+            # last *acked* segment (replica-invariant — the raw physical tail
+            # can be a replica-local pending segment); failing that, detach
+            from ..core.constants import SEQ_UNASSIGNED
             last = None
             for s in self.tree.segments:
                 if _visible(s, ref_seq, client):
                     last = s
             if last is None:
-                if not self.tree.segments:
-                    raise IndexError("interval on empty document")
-                last = self.tree.segments[-1]
+                for s in self.tree.segments:
+                    if s.seq != SEQ_UNASSIGNED:
+                        last = s
+            if last is None:
+                return LocalReference(None, 0, SlidePolicy.SLIDE)
             seg, offset = last, max(last.length - 1, 0)
         ref = LocalReference(seg, offset, SlidePolicy.SLIDE)
         seg.refs.append(ref)

@@ -477,19 +477,31 @@ class MergeTree:
         """Collaboration-window cleanup once minSeq advances (reference:
         merge-tree zamboni). Physically deletes tombstones whose removal is
         acked at or below ``min_seq`` and coalesces adjacent same-era live
-        segments. Returns number of segments freed."""
+        segments. Returns number of segments freed.
+
+        Two phases: refs slide off every doomed segment FIRST (slide targets
+        are acked-live segments, which are never doomed and at worst get
+        coalesced later — coalescing migrates refs correctly), THEN the list
+        is rebuilt. Sliding mid-rebuild could target a segment the same pass
+        already coalesced away, leaving a dangling anchor."""
         self.min_seq = max(self.min_seq, min_seq)
-        freed = 0
-        kept: List[Segment] = []
-        for idx, seg in enumerate(self.segments):
-            dead = (
+
+        def _dead(seg: Segment) -> bool:
+            return (
                 seg.removed_seq is not None
                 and seg.removed_seq != SEQ_UNASSIGNED
                 and seg.removed_seq <= self.min_seq
                 and seg.local_remove_op is None
             )
-            if dead:
+
+        for idx, seg in enumerate(self.segments):
+            if _dead(seg):
                 self._slide_refs(idx)
+
+        freed = 0
+        kept: List[Segment] = []
+        for seg in self.segments:
+            if _dead(seg):
                 freed += 1
                 continue
             prev = kept[-1] if kept else None

@@ -31,7 +31,8 @@ class MapKernel:
     _CLEAR = object()
 
     def __init__(self):
-        self.data: Dict[str, Any] = {}
+        self.data: Dict[str, Any] = {}           # optimistic (read) view
+        self.acked: Dict[str, Any] = {}          # pure sequenced state
         self.pending_keys: Dict[str, int] = {}   # key -> outstanding local ops
         self.pending_clears = 0
         import collections
@@ -56,8 +57,21 @@ class MapKernel:
         self._pending_fifo.append(self._CLEAR)
         return {"op": "clear"}
 
+    def _apply_acked(self, op: dict) -> None:
+        """Pure sequenced replay — every op, no shadowing. This is the state
+        summaries serialize (pending local values must never leak into a
+        summary, and the acked value must survive being shadowed locally)."""
+        kind = op["op"]
+        if kind == "clear":
+            self.acked.clear()
+        elif kind == "set":
+            self.acked[op["key"]] = op["value"]
+        elif kind == "delete":
+            self.acked.pop(op["key"], None)
+
     # sequenced inbox
     def process(self, op: dict, local: bool) -> None:
+        self._apply_acked(op)
         kind = op["op"]
         if local:
             entry = self._pending_fifo.popleft()
@@ -126,13 +140,13 @@ class SharedMap(SharedObject):
         self.kernel.process(msg.contents, local)
 
     def summarize(self) -> dict:
-        # pending local state is never part of a summary
-        acked = {k: v for k, v in self.kernel.data.items()
-                 if k not in self.kernel.pending_keys}
-        return {"type": self.TYPE, "data": acked}
+        # the acked shadow: never contains optimistic local values, and keeps
+        # the sequenced value even while a local op for the key is in flight
+        return {"type": self.TYPE, "data": dict(self.kernel.acked)}
 
     def load_core(self, summary: dict) -> None:
         self.kernel.data = dict(summary["data"])
+        self.kernel.acked = dict(summary["data"])
 
 
 class SharedDirectory(SharedObject):
@@ -198,10 +212,7 @@ class SharedDirectory(SharedObject):
     def summarize(self) -> dict:
         return {
             "type": self.TYPE,
-            "nodes": {
-                p: {k: v for k, v in n.data.items() if k not in n.pending_keys}
-                for p, n in self._nodes.items()
-            },
+            "nodes": {p: dict(n.acked) for p, n in self._nodes.items()},
         }
 
     def load_core(self, summary: dict) -> None:
@@ -209,4 +220,5 @@ class SharedDirectory(SharedObject):
         for p, data in summary["nodes"].items():
             k = MapKernel()
             k.data = dict(data)
+            k.acked = dict(data)
             self._nodes[p] = k

@@ -258,10 +258,33 @@ class SharedMatrix(SharedObject):
 
     # ------------------------------------------------------------- summaries
 
+    _NO_CLIENT_VIEW = -(10**9)  # acked view: no client's pending/remover bits
+
+    def _acked_grid(self):
+        """Grid of acked state in the acked perspective (pending local row/col
+        inserts and optimistic cell overrides excluded — summaries are
+        acked-only, like every other DDS)."""
+        nc = self._NO_CLIENT_VIEW
+        grid = []
+        rows = sum(s.length for s in
+                   self.rows.tree.visible_segments(LOCAL_VIEW, nc))
+        cols = sum(s.length for s in
+                   self.cols.tree.visible_segments(LOCAL_VIEW, nc))
+        for i in range(rows):
+            rk = self.rows.resolve(i, LOCAL_VIEW, nc)
+            row = []
+            for j in range(cols):
+                ck = self.cols.resolve(j, LOCAL_VIEW, nc)
+                row.append([self.acked_cells.get((rk, ck)),
+                            self.cell_seq.get((rk, ck), 0),
+                            self.cell_writer.get((rk, ck), 0)])
+            grid.append(row)
+        return rows, cols, grid
+
     def summarize(self) -> dict:
-        grid = self.to_lists()
-        return {"type": self.TYPE, "rows": self.row_count,
-                "cols": self.col_count, "grid": grid, "fww": self.fww}
+        rows, cols, grid = self._acked_grid()
+        return {"type": self.TYPE, "rows": rows, "cols": cols, "grid": grid,
+                "fww": self.fww}
 
     def load_core(self, summary: dict) -> None:
         r, c = summary["rows"], summary["cols"]
@@ -272,8 +295,12 @@ class SharedMatrix(SharedObject):
             self.cols.insert(0, c, (0, 2), 0, -1, 0, None)
         for i in range(r):
             for j in range(c):
-                v = summary["grid"][i][j]
-                if v is not None:
+                v, seq, writer = summary["grid"][i][j]
+                if v is not None or seq:
                     rk = self.rows.resolve(i, LOCAL_VIEW, self.client_id)
                     ck = self.cols.resolve(j, LOCAL_VIEW, self.client_id)
-                    self.acked_cells[(rk, ck)] = v
+                    if v is not None:
+                        self.acked_cells[(rk, ck)] = v
+                    # FWW needs the write provenance to survive reloads
+                    self.cell_seq[(rk, ck)] = seq
+                    self.cell_writer[(rk, ck)] = writer

@@ -14,7 +14,7 @@ from typing import Any, Dict, Optional
 
 from ..core.protocol import SequencedDocumentMessage
 from .interval_collection import IntervalCollection
-from .merge_tree import MergeTree, SlidePolicy
+from .merge_tree import LOCAL_VIEW, MergeTree, SlidePolicy
 from .merge_tree_client import SequenceClient
 from .shared_object import SharedObject
 
@@ -234,7 +234,7 @@ class IntervalCollectionView:
         o = self._owner
         o._iv_clientseq += 1
         iid = f"iv-{o.client_id}-{o._iv_clientseq}"
-        self._coll.apply_add(iid, start, end, props, ref_seq=2**31 - 1,
+        self._coll.apply_add(iid, start, end, props, ref_seq=LOCAL_VIEW,
                              client=o.client_id)
         o.submit_local_message({"iv": "add", "label": self._coll.label,
                                 "id": iid, "start": start, "end": end,
@@ -254,7 +254,7 @@ class IntervalCollectionView:
         ticket = o._iv_ticket
         fields = o._change_fields(start, end, props)
         applied = self._coll.apply_change(interval_id, start, end, props,
-                                          ref_seq=2**31 - 1, client=o.client_id)
+                                          ref_seq=LOCAL_VIEW, client=o.client_id)
         if applied:
             for f in fields:
                 o._iv_last_ticket[(interval_id, f)] = ticket
@@ -262,9 +262,9 @@ class IntervalCollectionView:
         else:
             # target's add op still in flight: pre-resolve anchors in today's
             # view so the ack can attach them without re-resolving positions
-            sref = (self._coll._anchor(start, 2**31 - 1, o.client_id)
+            sref = (self._coll._anchor(start, LOCAL_VIEW, o.client_id)
                     if start is not None else None)
-            eref = (self._coll._anchor(end, 2**31 - 1, o.client_id)
+            eref = (self._coll._anchor(end, LOCAL_VIEW, o.client_id)
                     if end is not None else None)
             o._iv_applied.append((False, (sref, eref, props, ticket)))
         for f in fields:

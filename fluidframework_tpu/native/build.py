@@ -1,0 +1,38 @@
+"""Build the native components (g++ → shared libraries for ctypes).
+
+Usage: ``python -m fluidframework_tpu.native.build`` or import
+``ensure_built()`` for build-on-demand (used by the ctypes wrappers, with a
+pure-Python fallback if no toolchain is present).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+TARGETS = {
+    "libdeli.so": ["sequencer.cpp"],
+}
+
+
+def ensure_built(target: str = "libdeli.so") -> str | None:
+    """Path to the built library, or None if it cannot be built."""
+    out = os.path.join(HERE, target)
+    srcs = [os.path.join(HERE, s) for s in TARGETS[target]]
+    if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", out, *srcs]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    return out
+
+
+if __name__ == "__main__":
+    for t in TARGETS:
+        path = ensure_built(t)
+        print(f"{t}: {'built at ' + path if path else 'BUILD FAILED'}")

@@ -1,0 +1,192 @@
+"""Batched SharedMap op-apply kernel: the first end-to-end device slice.
+
+Reference counterpart: the ``MapKernel.tryProcessMessage`` inner loop of
+``@fluidframework/map`` (SURVEY.md §2.3) — but where the reference applies one
+JSON op at a time per JS object, this kernel applies a (doc × op) batch of
+packed records for thousands of documents in one jit'd call (SURVEY.md §7.3:
+"the minimum slice").
+
+Layout
+------
+State per document: ``K`` dense key slots (host interns string keys → slot
+ids per doc). Three (D, K) int32 planes:
+
+    present  — 1 if the key currently has a value
+    value    — payload handle (host side table holds the actual JSON value)
+    last_seq — seq of the write that set it (debug/digest/FWW-style queries)
+
+Op batch: (D, O) planes (kind/a0/a1/seq) — the sequencer lays ops out densely
+per doc, padding with NOOP. Total order within a doc = ascending op index.
+
+Because map semantics are last-writer-wins with ``clear`` barriers, a whole
+batch collapses without a sequential scan: for each (doc, key) the result
+depends only on the LAST relevant op after the LAST clear — a pure reduction
+over the op axis (max-index tricks), which vectorizes perfectly on the VPU.
+No data-dependent control flow, fully static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .schema import OpKind
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MapState:
+    """Device-resident state for D documents × K key slots."""
+
+    present: jax.Array   # (D, K) int32 0/1
+    value: jax.Array     # (D, K) int32 payload handle
+    last_seq: jax.Array  # (D, K) int32
+
+    @staticmethod
+    def create(n_docs: int, n_keys: int) -> "MapState":
+        # three distinct buffers: the apply step donates its input state, and
+        # XLA rejects donating one aliased buffer for multiple arguments
+        z = lambda: jnp.zeros((n_docs, n_keys), dtype=jnp.int32)
+        return MapState(present=z(), value=z(), last_seq=z())
+
+
+def apply_map_batch(state: MapState, kind: jax.Array, a0: jax.Array,
+                    a1: jax.Array, seq: jax.Array) -> MapState:
+    """Apply a dense (D, O) batch of sequenced map ops.
+
+    kind/a0/a1/seq: (D, O) int32 — OpKind, key slot, value handle, seq.
+    Pure reduction over the op axis; jit/vmap/shard_map-friendly.
+    """
+    n_keys = state.present.shape[1]
+    o_idx = jnp.arange(kind.shape[1], dtype=jnp.int32)          # (O,)
+
+    is_clear = kind == OpKind.MAP_CLEAR
+    is_set = kind == OpKind.MAP_SET
+    is_del = kind == OpKind.MAP_DELETE
+
+    # index of the last clear per doc (-1 if none)
+    last_clear = jnp.max(jnp.where(is_clear, o_idx[None, :], -1), axis=1)  # (D,)
+
+    # last relevant key-op per (doc, key): max op index among set/delete ops
+    # targeting that key after the last clear
+    key_onehot = a0[:, :, None] == jnp.arange(n_keys)[None, None, :]  # (D,O,K)
+    relevant = ((is_set | is_del) & (o_idx[None, :] > -1)
+                & (o_idx[None, :] > last_clear[:, None]))
+    cand = jnp.where(relevant[:, :, None] & key_onehot, o_idx[None, :, None], -1)
+    last_op = jnp.max(cand, axis=1)                              # (D, K)
+
+    had_clear = last_clear >= 0                                   # (D,)
+    touched = last_op >= 0                                        # (D, K)
+
+    safe_idx = jnp.maximum(last_op, 0)
+    g = lambda plane: jnp.take_along_axis(plane, safe_idx, axis=1)
+    op_is_set = g(kind) == OpKind.MAP_SET                         # (D, K)
+    op_value = g(a1)
+    op_seq = g(seq)
+
+    base_present = jnp.where(had_clear[:, None], 0, state.present)
+    base_value = jnp.where(had_clear[:, None], 0, state.value)
+    base_seq = jnp.where(had_clear[:, None], 0, state.last_seq)
+
+    present = jnp.where(touched, op_is_set.astype(jnp.int32), base_present)
+    value = jnp.where(touched & op_is_set, op_value, base_value)
+    last_seq = jnp.where(touched, jnp.where(op_is_set, op_seq, 0), base_seq)
+    return MapState(present=present, value=value, last_seq=last_seq)
+
+
+apply_map_batch_jit = jax.jit(apply_map_batch, donate_argnums=0)
+
+
+def map_state_digest(state: MapState) -> jax.Array:
+    """Per-doc digest of converged state for cross-replica checks (the
+    race-detection analog, SURVEY.md §5.2)."""
+    k = jnp.arange(state.present.shape[1], dtype=jnp.int32)
+    mix = state.present * (k[None, :] * 1103515245 + 12345) \
+        + state.value * 40503 + state.last_seq
+    return jnp.sum(jnp.where(state.present > 0, mix, 0), axis=1)
+
+
+class TensorMapStore:
+    """Host facade: many SharedMap documents resident on device.
+
+    Interns string keys / JSON values into int32 handles, packs sequenced ops
+    into dense (D, O) batches, applies them in one jit'd call, and reads back
+    per-doc dicts. This is the serving-side merge engine; interactive
+    optimistic editing stays in ``models.SharedMap`` (host).
+    """
+
+    def __init__(self, n_docs: int, n_keys: int = 64):
+        self.n_docs = n_docs
+        self.n_keys = n_keys
+        self.state = MapState.create(n_docs, n_keys)
+        self._key_ids: List[Dict[str, int]] = [dict() for _ in range(n_docs)]
+        self._values: List = [None]  # handle 0 = reserved
+        self._value_ids: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- interning
+
+    def key_slot(self, doc: int, key: str) -> int:
+        ids = self._key_ids[doc]
+        if key not in ids:
+            if len(ids) >= self.n_keys:
+                raise KeyError(f"doc {doc}: key capacity {self.n_keys} exhausted")
+            ids[key] = len(ids)
+        return ids[key]
+
+    def value_handle(self, value) -> int:
+        import json
+        enc = json.dumps(value, sort_keys=True)
+        if enc not in self._value_ids:
+            self._value_ids[enc] = len(self._values)
+            self._values.append(value)
+        return self._value_ids[enc]
+
+    # ----------------------------------------------------------------- apply
+
+    def apply_batch(self, records) -> None:
+        """records: iterable of (doc, kind, key, value, seq) with key=str,
+        value=JSON for sets (None otherwise). Sequenced (seq ascending)."""
+        per_doc: Dict[int, list] = {}
+        for doc, kind, key, value, seq in records:
+            slot = self.key_slot(doc, key) if key is not None else 0
+            handle = self.value_handle(value) if kind == OpKind.MAP_SET else 0
+            per_doc.setdefault(doc, []).append((int(kind), slot, handle, seq))
+        if not per_doc:
+            return
+        # pad the op axis to a power-of-two bucket: a fresh (D, O) shape per
+        # call would retrigger XLA compilation on nearly every batch
+        widest = max(len(v) for v in per_doc.values())
+        o = 8
+        while o < widest:
+            o *= 2
+        kind = np.full((self.n_docs, o), int(OpKind.NOOP), dtype=np.int32)
+        a0 = np.zeros((self.n_docs, o), dtype=np.int32)
+        a1 = np.zeros((self.n_docs, o), dtype=np.int32)
+        seq = np.zeros((self.n_docs, o), dtype=np.int32)
+        for doc, ops in per_doc.items():
+            for j, (k_, s_, h_, q_) in enumerate(ops):
+                kind[doc, j] = k_
+                a0[doc, j] = s_
+                a1[doc, j] = h_
+                seq[doc, j] = q_
+        self.state = apply_map_batch_jit(
+            self.state, jnp.asarray(kind), jnp.asarray(a0), jnp.asarray(a1),
+            jnp.asarray(seq))
+
+    # ----------------------------------------------------------------- reads
+
+    def read_doc(self, doc: int) -> dict:
+        present = np.asarray(self.state.present[doc])
+        value = np.asarray(self.state.value[doc])
+        out = {}
+        for key, slot in self._key_ids[doc].items():
+            if present[slot]:
+                out[key] = self._values[value[slot]]
+        return out
+
+    def digests(self) -> np.ndarray:
+        return np.asarray(map_state_digest(self.state))

@@ -1,0 +1,298 @@
+"""Batched MergeTree op-apply kernel — the north-star hot path on device.
+
+Reference counterpart: ``@fluidframework/merge-tree`` ``MergeTree.
+insertSegments`` / ``markRangeRemoved`` and the container-runtime ``processOp``
+loop above them (SURVEY.md §2.1, §3.2). The reference walks a B-tree object
+graph per op; here the *entire* merge — position resolution in the op's
+(refSeq, client) perspective, concurrent-insert tie-break, segment splits,
+tombstoning with overlapping removes — is (doc × op × segment) tensor math:
+one ``lax.scan`` over the op axis (total order per doc is a hard data
+dependency) with every document in the batch advanced in parallel per step.
+
+Design invariants that make this tractable on a TPU:
+
+- **Acked-only state.** The device holds sequenced state only; optimistic
+  local ops, acks, and rebase live in the host client (``models``). With no
+  pending segments, the reference's tie-break ("new segment goes after
+  pending-local segments, before lower-seq acked ones") collapses to: *insert
+  at the leftmost slot whose perspective-prefix equals the position* — every
+  acked segment has seq < the incoming op's seq. Later-sequenced concurrent
+  inserts therefore land left of earlier ones, exactly like the oracle.
+- **Position-ordered dense slots.** Active segments occupy slots 0..n-1 in
+  document order. Inserts/splits rebuild the slot arrays with one gather
+  (O(S) vector work per op per doc — vector lanes, not pointer chases).
+- **Client indexes + remover bitmask.** Clients of a doc are interned to
+  indexes 0..31 by the host; "removed by client c" (needed for perspectives
+  whose refSeq predates the client's own removal) is one bit in an int32
+  plane, supporting the reference's overlapping-remove client list.
+- **Payload handles.** Text bytes never reach the device: segments carry
+  (handle_op, handle_off, len); splits just offset the handle, and the host
+  text table materializes strings on read. Markers are length-1 runs with a
+  marker-table handle.
+
+Capacity: S slots per doc. An op that would overflow S sets a sticky per-doc
+overflow flag and leaves the doc unchanged; the host drains such docs through
+the oracle and re-uploads after compaction (the gap-buffer escape hatch of
+SURVEY.md §7 risk (b)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.constants import NOT_REMOVED
+from .schema import OpKind
+
+MAX_CLIENTS = 32  # remover bitmask width (int32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StringState:
+    """Device-resident acked merge-tree state for D docs × S segment slots."""
+
+    seq: jax.Array          # (D, S) int32 insert seq
+    client: jax.Array       # (D, S) int32 inserting client index
+    removed_seq: jax.Array  # (D, S) int32, NOT_REMOVED if live
+    removers: jax.Array     # (D, S) int32 bitmask of removing client indexes
+    length: jax.Array       # (D, S) int32 run length
+    handle_op: jax.Array    # (D, S) int32 payload table id
+    handle_off: jax.Array   # (D, S) int32 offset within the payload
+    count: jax.Array        # (D,)  int32 active slot count
+    overflow: jax.Array     # (D,)  int32 sticky overflow flag
+
+    @staticmethod
+    def create(n_docs: int, capacity: int) -> "StringState":
+        z = lambda fill=0: jnp.full((n_docs, capacity), fill, dtype=jnp.int32)
+        return StringState(
+            seq=z(), client=z(), removed_seq=z(NOT_REMOVED), removers=z(),
+            length=z(), handle_op=z(), handle_off=z(),
+            count=jnp.zeros((n_docs,), jnp.int32),
+            overflow=jnp.zeros((n_docs,), jnp.int32),
+        )
+
+
+# ----------------------------------------------------------- single-doc math
+# All helpers below operate on ONE document (S-vectors) and are vmapped over
+# the doc axis by the batch step.
+
+def _active(s, S):
+    return jnp.arange(S) < s["count"]
+
+
+def _visible(s, ref_seq, client_idx):
+    S = s["seq"].shape[0]
+    ins = (s["seq"] <= ref_seq) | (s["client"] == client_idx)
+    rem = (s["removed_seq"] <= ref_seq) | \
+          (((s["removers"] >> jnp.clip(client_idx, 0, MAX_CLIENTS - 1)) & 1)
+           .astype(bool) & (client_idx >= 0))
+    return _active(s, S) & ins & ~rem
+
+
+def _prefix(s, vis):
+    pl = jnp.where(vis, s["length"], 0)
+    cum = jnp.cumsum(pl)
+    return cum - pl, cum - pl + pl  # (exclusive prefix, inclusive end)
+
+
+_PLANES = ("seq", "client", "removed_seq", "removers", "length",
+           "handle_op", "handle_off")
+
+
+def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq):
+    """Apply one insert to one doc (S-vector planes in dict s)."""
+    S = s["seq"].shape[0]
+    i = jnp.arange(S)
+    vis = _visible(s, ref_seq, client_idx)
+    pre, end = _prefix(s, vis)
+
+    inside = vis & (pre < pos) & (pos < end)
+    has_inside = jnp.any(inside)
+    j = jnp.argmax(inside)                      # containing slot (split case)
+    off = pos - pre[j]
+
+    bcand = _active(s, S) & (pre >= pos)
+    idx_b = jnp.where(jnp.any(bcand), jnp.argmax(bcand), s["count"])
+
+    shift = jnp.where(has_inside, 2, 1).astype(jnp.int32)
+    new_count = s["count"] + shift
+    would_overflow = new_count > S
+
+    new_slot = jnp.where(has_inside, j + 1, idx_b)
+    src = jnp.where(
+        has_inside,
+        jnp.where(i <= j, i, jnp.where(i == j + 2, j, i - 2)),
+        jnp.where(i < idx_b, i, i - 1),
+    )
+    src = jnp.clip(src, 0, S - 1)
+
+    out = {k: s[k][src] for k in _PLANES}
+    is_new = i == new_slot
+    is_left = has_inside & (i == j)
+    is_right = has_inside & (i == j + 2)
+
+    out["length"] = jnp.where(
+        is_new, length,
+        jnp.where(is_left, off,
+                  jnp.where(is_right, s["length"][j] - off, out["length"])))
+    out["handle_off"] = jnp.where(
+        is_new, 0,
+        jnp.where(is_right, s["handle_off"][j] + off, out["handle_off"]))
+    out["handle_op"] = jnp.where(is_new, handle, out["handle_op"])
+    out["seq"] = jnp.where(is_new, seq, out["seq"])
+    out["client"] = jnp.where(is_new, client_idx, out["client"])
+    out["removed_seq"] = jnp.where(is_new, NOT_REMOVED, out["removed_seq"])
+    out["removers"] = jnp.where(is_new, 0, out["removers"])
+    out["count"] = new_count
+    out["overflow"] = s["overflow"]
+
+    # overflow: leave the doc untouched, set the sticky flag
+    res = {k: jnp.where(would_overflow, s[k], out[k]) for k in _PLANES}
+    res["count"] = jnp.where(would_overflow, s["count"], new_count)
+    res["overflow"] = jnp.where(would_overflow, 1, s["overflow"])
+    return res
+
+
+def _split_at(s, p, ref_seq, client_idx):
+    """Split the visible segment strictly containing perspective position p."""
+    S = s["seq"].shape[0]
+    i = jnp.arange(S)
+    vis = _visible(s, ref_seq, client_idx)
+    pre, end = _prefix(s, vis)
+    inside = vis & (pre < p) & (p < end)
+    has_inside = jnp.any(inside)
+    j = jnp.argmax(inside)
+    off = p - pre[j]
+
+    new_count = s["count"] + 1
+    would_overflow = new_count > S
+    do = has_inside & ~would_overflow
+
+    src = jnp.where(i <= j, i, jnp.where(i == j + 1, j, i - 1))
+    src = jnp.clip(src, 0, S - 1)
+    out = {k: s[k][src] for k in _PLANES}
+    is_left = i == j
+    is_right = i == j + 1
+    out["length"] = jnp.where(
+        is_left, off,
+        jnp.where(is_right, s["length"][j] - off, out["length"]))
+    out["handle_off"] = jnp.where(
+        is_right, s["handle_off"][j] + off, out["handle_off"])
+
+    res = {k: jnp.where(do, out[k], s[k]) for k in _PLANES}
+    res["count"] = jnp.where(do, new_count, s["count"])
+    res["overflow"] = jnp.where(has_inside & would_overflow, 1, s["overflow"])
+    return res
+
+
+def _remove_one(s, start, end_pos, seq, client_idx, ref_seq):
+    """Mark [start, end) removed in the op's perspective (two splits + mark).
+
+    Only segments visible to the remover are marked — concurrently inserted
+    text inside the range survives, overlapping removes keep the earliest
+    acked removal seq and accumulate remover bits (reference semantics)."""
+    s = _split_at(s, start, ref_seq, client_idx)
+    s = _split_at(s, end_pos, ref_seq, client_idx)
+    vis = _visible(s, ref_seq, client_idx)
+    pre, endp = _prefix(s, vis)
+    target = vis & (pre >= start) & (endp <= end_pos) & (s["length"] > 0)
+    bit = jnp.where(client_idx >= 0,
+                    (1 << jnp.clip(client_idx, 0, MAX_CLIENTS - 1)), 0)
+    out = dict(s)
+    out["removed_seq"] = jnp.where(
+        target, jnp.minimum(s["removed_seq"], seq), s["removed_seq"])
+    out["removers"] = jnp.where(target, s["removers"] | bit, s["removers"])
+    return out
+
+
+def _annotate_one(s, start, end_pos, seq, client_idx, ref_seq):
+    """Annotate ranges device-side v1: split boundaries so the host can apply
+    properties to exact slots; property planes land in a later revision."""
+    s = _split_at(s, start, ref_seq, client_idx)
+    s = _split_at(s, end_pos, ref_seq, client_idx)
+    return s
+
+
+# ------------------------------------------------------------- batched apply
+
+def _state_dict(state: StringState):
+    return {
+        "seq": state.seq, "client": state.client,
+        "removed_seq": state.removed_seq, "removers": state.removers,
+        "length": state.length, "handle_op": state.handle_op,
+        "handle_off": state.handle_off, "count": state.count,
+        "overflow": state.overflow,
+    }
+
+
+def apply_string_batch(state: StringState, kind, a0, a1, a2, seq, client,
+                       ref_seq) -> StringState:
+    """Apply a dense (D, O) batch of sequenced merge-tree ops.
+
+    kind/a0/a1/a2/seq/client/ref_seq: (D, O) int32 planes. Per doc, ops apply
+    in ascending op index (the sequencer's total order); NOOP pads.
+    STR_INSERT: a0=pos, a1=len, a2=payload handle. STR_REMOVE: a0=start,
+    a1=end.
+    """
+    sd = _state_dict(state)
+
+    def step(carry, op):
+        k, p0, p1, p2, sq, cl, rs = op
+
+        ins = jax.vmap(_insert_one)(carry, p0, p1, p2, sq, cl, rs)
+        rem = jax.vmap(_remove_one)(carry, p0, p1, sq, cl, rs)
+
+        def pick(key):
+            is_ins = (k == OpKind.STR_INSERT)[:, None] \
+                if carry[key].ndim == 2 else (k == OpKind.STR_INSERT)
+            is_rem = (k == OpKind.STR_REMOVE)[:, None] \
+                if carry[key].ndim == 2 else (k == OpKind.STR_REMOVE)
+            return jnp.where(is_ins, ins[key],
+                             jnp.where(is_rem, rem[key], carry[key]))
+
+        return {key: pick(key) for key in carry}, None
+
+    ops = (kind.T, a0.T, a1.T, a2.T, seq.T, client.T, ref_seq.T)  # (O, D)
+    out, _ = jax.lax.scan(step, sd, ops)
+    return StringState(**out)
+
+
+apply_string_batch_jit = jax.jit(apply_string_batch, donate_argnums=0)
+
+
+def compact_string_state(state: StringState, min_seq) -> StringState:
+    """Zamboni on device: drop tombstones whose removal is acked at or below
+    minSeq (reference: merge-tree zamboni; SURVEY.md §7.4 "compaction kernel
+    keyed on MSN"). Stable partition keeps document order. min_seq: (D,)."""
+    sd = _state_dict(state)
+    S = state.seq.shape[1]
+
+    def one(s, ms):
+        active = jnp.arange(S) < s["count"]
+        keep = active & ~(s["removed_seq"] <= ms)
+        perm = jnp.argsort(~keep, stable=True)
+        out = {k: s[k][perm] for k in _PLANES}
+        out["count"] = jnp.sum(keep.astype(jnp.int32))
+        out["overflow"] = s["overflow"]
+        return out
+
+    return StringState(**jax.vmap(one)(sd, min_seq))
+
+
+def string_state_digest(state: StringState) -> jax.Array:
+    """Per-doc content digest, invariant to split boundaries: for a live run
+    (handle_op, handle_off) at visible position pos, (handle_off - pos) is
+    identical for every piece of the same insert, so the per-slot mix sums to
+    the same value however the run is physically split."""
+    S = state.seq.shape[1]
+    active = jnp.arange(S)[None, :] < state.count[:, None]
+    live = active & (state.removed_seq == NOT_REMOVED)
+    pl = jnp.where(live, state.length, 0)
+    pre = jnp.cumsum(pl, axis=1) - pl
+    mix = (state.handle_op * 1000003 + (state.handle_off - pre) * 8191) * pl
+    return jnp.sum(jnp.where(live, mix, 0), axis=1) + jnp.sum(pl, axis=1)

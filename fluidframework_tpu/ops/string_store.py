@@ -1,0 +1,148 @@
+"""Host facade for the batched merge-tree kernel: many SharedString documents
+resident on device.
+
+This is the serving/replica-side merge engine of the north star (sequenced
+ops only); interactive optimistic editing remains in ``models.SharedString``.
+The store interns variable-length payloads (text runs, markers) into an int32
+handle table — the device does ordering/position math, never string bytes
+(SURVEY.md §7.2) — and maps client ids to per-doc indexes for the remover
+bitmask.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.constants import NOT_REMOVED
+from .merge_tree_kernel import (
+    MAX_CLIENTS, StringState, apply_string_batch_jit, compact_string_state,
+    string_state_digest,
+)
+from .schema import OpKind
+
+_TEXT = 0
+_MARKER = 1
+
+
+class TensorStringStore:
+    def __init__(self, n_docs: int, capacity: int = 256):
+        self.n_docs = n_docs
+        self.capacity = capacity
+        self.state = StringState.create(n_docs, capacity)
+        self._payloads: List[Tuple[int, str]] = [(_TEXT, "")]  # handle 0
+        self._client_idx: List[Dict[int, int]] = [dict() for _ in range(n_docs)]
+
+    # ------------------------------------------------------------- interning
+
+    def _client(self, doc: int, client_id: int) -> int:
+        m = self._client_idx[doc]
+        if client_id not in m:
+            if len(m) >= MAX_CLIENTS:
+                raise KeyError(f"doc {doc}: client capacity {MAX_CLIENTS}")
+            m[client_id] = len(m)
+        return m[client_id]
+
+    def _payload(self, kind: int, text: str) -> int:
+        self._payloads.append((kind, text))
+        return len(self._payloads) - 1
+
+    # ----------------------------------------------------------------- apply
+
+    def apply_messages(self, messages) -> None:
+        """messages: iterable of (doc, SequencedDocumentMessage) carrying
+        merge-tree op contents (the ``mt`` dicts of SequenceClient)."""
+        per_doc: Dict[int, list] = {}
+        for doc, msg in messages:
+            op = msg.contents
+            cl = self._client(doc, msg.client_id)
+            if op["mt"] == "insert":
+                if op["kind"] == 1:  # marker
+                    handle = self._payload(_MARKER, "")
+                    length = 1
+                else:
+                    handle = self._payload(_TEXT, op["text"])
+                    length = len(op["text"])
+                if length == 0:
+                    continue  # empty insert: no segment anywhere
+                rec = (int(OpKind.STR_INSERT), op["pos"], length, handle,
+                       msg.seq, cl, msg.ref_seq)
+            elif op["mt"] == "remove":
+                rec = (int(OpKind.STR_REMOVE), op["start"], op["end"], 0,
+                       msg.seq, cl, msg.ref_seq)
+            elif op["mt"] == "annotate":
+                continue  # properties are host-side in v1
+            else:
+                raise ValueError(f"unknown op {op['mt']!r}")
+            per_doc.setdefault(doc, []).append(rec)
+        if not per_doc:
+            return
+        # power-of-two op-axis buckets keep jit cache hits (static shapes)
+        widest = max(len(v) for v in per_doc.values())
+        o = 8
+        while o < widest:
+            o *= 2
+        planes = {
+            "kind": np.full((self.n_docs, o), int(OpKind.NOOP), np.int32),
+            "a0": np.zeros((self.n_docs, o), np.int32),
+            "a1": np.zeros((self.n_docs, o), np.int32),
+            "a2": np.zeros((self.n_docs, o), np.int32),
+            "seq": np.zeros((self.n_docs, o), np.int32),
+            "client": np.zeros((self.n_docs, o), np.int32),
+            "ref_seq": np.zeros((self.n_docs, o), np.int32),
+        }
+        for doc, recs in per_doc.items():
+            for j, (k, x0, x1, x2, sq, cl, rs) in enumerate(recs):
+                planes["kind"][doc, j] = k
+                planes["a0"][doc, j] = x0
+                planes["a1"][doc, j] = x1
+                planes["a2"][doc, j] = x2
+                planes["seq"][doc, j] = sq
+                planes["client"][doc, j] = cl
+                planes["ref_seq"][doc, j] = rs
+        self.state = apply_string_batch_jit(
+            self.state, *(jnp.asarray(planes[k]) for k in
+                          ("kind", "a0", "a1", "a2", "seq", "client",
+                           "ref_seq")))
+
+    def compact(self, min_seq) -> None:
+        """Zamboni: free tombstones below the collaboration window."""
+        ms = jnp.full((self.n_docs,), int(min_seq), jnp.int32) \
+            if np.isscalar(min_seq) else jnp.asarray(min_seq, jnp.int32)
+        self.state = compact_string_state(self.state, ms)
+
+    # ----------------------------------------------------------------- reads
+
+    def read_text(self, doc: int) -> str:
+        st = self.state
+        n = int(st.count[doc])
+        rem = np.asarray(st.removed_seq[doc][:n])
+        hop = np.asarray(st.handle_op[doc][:n])
+        hoff = np.asarray(st.handle_off[doc][:n])
+        length = np.asarray(st.length[doc][:n])
+        parts = []
+        for i in range(n):
+            if rem[i] != NOT_REMOVED:
+                continue
+            kind, text = self._payloads[hop[i]]
+            if kind == _TEXT:
+                parts.append(text[hoff[i]:hoff[i] + length[i]])
+        return "".join(parts)
+
+    def visible_length(self, doc: int) -> int:
+        st = self.state
+        n = int(st.count[doc])
+        rem = np.asarray(st.removed_seq[doc][:n])
+        length = np.asarray(st.length[doc][:n])
+        return int(length[rem == NOT_REMOVED].sum())
+
+    def overflowed(self) -> np.ndarray:
+        return np.asarray(self.state.overflow)
+
+    def slot_usage(self) -> np.ndarray:
+        return np.asarray(self.state.count)
+
+    def digests(self) -> np.ndarray:
+        return np.asarray(string_state_digest(self.state))

@@ -1,1 +1,16 @@
-"""(populated as the build proceeds)"""
+"""Device-mesh parallelism: doc-axis sharding + replica broadcast collectives.
+
+Reference counterpart: document partitioning across Kafka partitions and the
+Broadcaster fan-out (SURVEY.md §2.13–§2.14, §5.8), re-expressed as
+``jax.sharding`` + ``shard_map`` with XLA collectives over ICI.
+"""
+
+from .mesh import make_mesh, DOC_AXIS, REPLICA_AXIS
+from .replicated import (
+    make_replicated_step, shard_state, shard_ops, STATE_SPEC, OPS_INGEST_SPEC,
+)
+
+__all__ = [
+    "make_mesh", "DOC_AXIS", "REPLICA_AXIS", "make_replicated_step",
+    "shard_state", "shard_ops", "STATE_SPEC", "OPS_INGEST_SPEC",
+]

@@ -1,0 +1,37 @@
+"""Device mesh construction for the doc-sharded merge engine.
+
+Reference counterpart: the scaling axis of Routerlicious — documents
+partitioned across Kafka partitions (SURVEY.md §2.13/§2.14). Documents are
+independent, so data parallelism over the doc axis is the native mapping;
+a second ``replica`` axis replicates each doc shard for redundancy and read
+scaling (the Broadcaster fan-out of §3.5 becomes an ICI all-gather of the
+sequenced op batch across replicas).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DOC_AXIS = "docs"
+REPLICA_AXIS = "replica"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              replicas: Optional[int] = None) -> Mesh:
+    """(replica, docs) mesh over the available devices.
+
+    ``replicas`` defaults to 2 when the device count is even and > 1 (so the
+    cross-replica digest check is meaningful), else 1.
+    """
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    if replicas is None:
+        replicas = 2 if n % 2 == 0 and n > 1 else 1
+    assert n % replicas == 0, (n, replicas)
+    grid = np.array(devices).reshape(replicas, n // replicas)
+    return Mesh(grid, (REPLICA_AXIS, DOC_AXIS))

@@ -1,0 +1,92 @@
+"""shard_map'd replicated merge step: the multi-chip op-apply pipeline.
+
+The TPU-native shape of the reference's server pipeline (SURVEY.md §3.5):
+
+- **doc axis sharded** over the ``docs`` mesh axis (Deli's Kafka partitioning
+  of documents);
+- **sequenced op batches broadcast** to every replica with an ICI
+  ``all_gather`` over the ``replica`` axis (the Broadcaster → Redis → client
+  fan-out);
+- every replica applies the same ops to its copy of the doc-shard state, and
+- a **cross-replica digest check** (``pmax``/``pmin`` over the replica axis)
+  asserts bit-identical convergence — the race-detection analog of the
+  reference's eventual-consistency fuzz asserts (SURVEY.md §5.2).
+
+Each replica *ingests* a disjoint 1/R slice of each doc's op batch (its
+"front door" share); the all-gather reassembles the full, seq-ordered batch
+on every replica before applying.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.merge_tree_kernel import (
+    StringState, apply_string_batch, string_state_digest,
+)
+from .mesh import DOC_AXIS, REPLICA_AXIS
+
+# state planes: (D, S) sharded over docs, replicated over replica axis
+STATE_SPEC = P(DOC_AXIS, None)
+COUNT_SPEC = P(DOC_AXIS)
+# op planes as ingested: (D, O) with the op axis split over replicas
+OPS_INGEST_SPEC = P(DOC_AXIS, REPLICA_AXIS)
+
+
+def _state_specs() -> StringState:
+    return StringState(
+        seq=STATE_SPEC, client=STATE_SPEC, removed_seq=STATE_SPEC,
+        removers=STATE_SPEC, length=STATE_SPEC, handle_op=STATE_SPEC,
+        handle_off=STATE_SPEC, count=COUNT_SPEC, overflow=COUNT_SPEC,
+    )
+
+
+def make_replicated_step(mesh):
+    """Build the jitted multi-chip step: (state, 7×(D,O) op planes) → (state,
+    digests, replicas_agree). Op planes arrive sharded (docs, replica)."""
+
+    # check_vma=False: after the all-gather the op batch is value-identical
+    # across replicas but typed as replica-varying; the explicit pmax/pmin
+    # digest agreement below is the (stronger, runtime) replication check.
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(_state_specs(),) + (OPS_INGEST_SPEC,) * 7,
+        out_specs=(_state_specs(), COUNT_SPEC, P()),
+        check_vma=False,
+    )
+    def step(state, kind, a0, a1, a2, seq, client, ref_seq):
+        # Broadcaster: reassemble the full sequenced batch on every replica
+        # via ICI all-gather over the replica axis (tiled on the op axis).
+        gather = lambda x: jax.lax.all_gather(
+            x, REPLICA_AXIS, axis=1, tiled=True)
+        full = tuple(gather(x) for x in (kind, a0, a1, a2, seq, client,
+                                         ref_seq))
+        new_state = apply_string_batch(state, *full)
+        digest = string_state_digest(new_state)
+        # race detection: every replica must hold bit-identical state
+        hi = jax.lax.pmax(digest, REPLICA_AXIS)
+        lo = jax.lax.pmin(digest, REPLICA_AXIS)
+        agree_local = jnp.all(hi == lo)
+        agree = jax.lax.pmin(
+            jax.lax.pmin(agree_local.astype(jnp.int32), REPLICA_AXIS),
+            DOC_AXIS)
+        return new_state, digest, agree
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def shard_state(state: StringState, mesh) -> StringState:
+    """Place host state onto the mesh with the step's shardings."""
+    specs = _state_specs()
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state, specs)
+
+
+def shard_ops(mesh, *planes):
+    sh = NamedSharding(mesh, OPS_INGEST_SPEC)
+    return tuple(jax.device_put(jnp.asarray(p), sh) for p in planes)

@@ -1,1 +1,18 @@
-"""(populated as the build proceeds)"""
+"""The ordering service (server stack).
+
+Reference counterpart: ``server/routerlicious`` (SURVEY.md §1, §2.13):
+Deli (sequencer), the partitioned ordered log (Kafka analog), Broadcaster,
+Scriptorium, Scribe, Historian, and the single-process bundle
+("tinylicious").
+"""
+
+from .deli import DeliSequencer, Nack, NackReason
+from .oplog import PartitionedLog, partition_of
+from .services import Broadcaster, Historian, Scribe, Scriptorium
+from .tinylicious import DeltaConnection, LocalService
+
+__all__ = [
+    "DeliSequencer", "Nack", "NackReason", "PartitionedLog", "partition_of",
+    "Broadcaster", "Historian", "Scribe", "Scriptorium", "DeltaConnection",
+    "LocalService",
+]

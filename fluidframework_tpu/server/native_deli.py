@@ -1,0 +1,143 @@
+"""ctypes binding for the native C++ Deli sequencer.
+
+Same policies as ``server.deli.DeliSequencer`` (parity-tested); adds a batch
+API for the ingest hot path. Falls back to the Python sequencer when the
+native library cannot be built (``available()`` reports which one you got).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..native.build import ensure_built
+from .deli import NackReason
+
+_NACK_BY_CODE = {
+    -1: NackReason.UNKNOWN_CLIENT,
+    -2: NackReason.CLIENT_SEQ_GAP,
+    -3: NackReason.DUPLICATE,
+    -4: NackReason.REF_SEQ_BELOW_MSN,
+}
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = ensure_built("libdeli.so")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.deli_create.restype = ctypes.c_void_p
+    lib.deli_destroy.argtypes = [ctypes.c_void_p]
+    lib.deli_client_join.restype = ctypes.c_int64
+    lib.deli_client_join.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int32]
+    lib.deli_client_leave.restype = ctypes.c_int64
+    lib.deli_client_leave.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int32]
+    lib.deli_sequence.restype = ctypes.c_int64
+    lib.deli_sequence.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int64)]
+    lib.deli_sequence_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.deli_doc_seq.restype = ctypes.c_int64
+    lib.deli_doc_seq.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.deli_doc_min_seq.restype = ctypes.c_int64
+    lib.deli_doc_min_seq.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.deli_checkpoint.restype = ctypes.c_int64
+    lib.deli_checkpoint.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int64]
+    lib.deli_restore.restype = ctypes.c_void_p
+    lib.deli_restore.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeDeli:
+    """C++ sequencer handle with the Python DeliSequencer's surface."""
+
+    def __init__(self, _handle=None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native sequencer unavailable (no toolchain)")
+        self._lib = lib
+        self._h = _handle if _handle is not None else lib.deli_create()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.deli_destroy(self._h)
+            self._h = None
+
+    def client_join(self, doc_id: str, client: int) -> int:
+        return self._lib.deli_client_join(self._h, doc_id.encode(), client)
+
+    def client_leave(self, doc_id: str, client: int) -> int:
+        return self._lib.deli_client_leave(self._h, doc_id.encode(), client)
+
+    def sequence(self, doc_id: str, client: int, client_seq: int,
+                 ref_seq: int, is_noop: bool = False
+                 ) -> Tuple[Optional[int], Optional[int],
+                            Optional[NackReason]]:
+        """(seq, min_seq, None) on success, (None, None, reason) on nack."""
+        out_min = ctypes.c_int64()
+        seq = self._lib.deli_sequence(
+            self._h, doc_id.encode(), client, client_seq, ref_seq,
+            int(is_noop), ctypes.byref(out_min))
+        if seq < 0:
+            return None, None, _NACK_BY_CODE[int(seq)]
+        return int(seq), int(out_min.value), None
+
+    def sequence_batch(self, doc_id: str, clients, client_seqs, ref_seqs,
+                       is_noop=None):
+        """Stamp a batch of raw ops for one doc; returns (seqs, min_seqs)
+        int64 arrays (negative seq = nack code)."""
+        clients = np.ascontiguousarray(clients, np.int32)
+        client_seqs = np.ascontiguousarray(client_seqs, np.int32)
+        ref_seqs = np.ascontiguousarray(ref_seqs, np.int32)
+        n = len(clients)
+        if is_noop is None:
+            is_noop = np.zeros(n, np.int32)
+        is_noop = np.ascontiguousarray(is_noop, np.int32)
+        out_seq = np.empty(n, np.int64)
+        out_min = np.empty(n, np.int64)
+        p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+        self._lib.deli_sequence_batch(
+            self._h, doc_id.encode(), n,
+            p(clients, ctypes.c_int32), p(client_seqs, ctypes.c_int32),
+            p(ref_seqs, ctypes.c_int32), p(is_noop, ctypes.c_int32),
+            p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
+        return out_seq, out_min
+
+    def doc_seq(self, doc_id: str) -> int:
+        return int(self._lib.deli_doc_seq(self._h, doc_id.encode()))
+
+    def doc_min_seq(self, doc_id: str) -> int:
+        return int(self._lib.deli_doc_min_seq(self._h, doc_id.encode()))
+
+    def checkpoint(self) -> bytes:
+        n = self._lib.deli_checkpoint(self._h, None, 0)
+        buf = ctypes.create_string_buffer(int(n))
+        self._lib.deli_checkpoint(self._h, buf, n)
+        return buf.raw[:n]
+
+    @classmethod
+    def restore(cls, blob: bytes) -> "NativeDeli":
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native sequencer unavailable")
+        h = lib.deli_restore(blob, len(blob))
+        return cls(_handle=h)

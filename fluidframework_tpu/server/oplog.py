@@ -1,0 +1,85 @@
+"""Partitioned, durable, ordered op log — the Kafka analog.
+
+Reference counterpart: Kafka as Routerlicious' ordering/communication
+backbone (SURVEY.md §1, §5.8): topics are partitioned, each partition is an
+ordered durable log, documents map to partitions, consumers track offsets.
+Here: an in-process partitioned log with optional JSONL spill to disk, used
+as (a) the raw-ops ingress queue, (b) the sequenced-deltas stream feeding
+broadcaster/scriptorium/scribe, and (c) the recovery source (a restarted
+lambda re-reads from its checkpointed offset).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+def partition_of(doc_id: str, n_partitions: int) -> int:
+    """Stable doc → partition mapping (document-level parallelism axis)."""
+    h = 2166136261
+    for ch in doc_id.encode():
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h % n_partitions
+
+
+class PartitionedLog:
+    def __init__(self, n_partitions: int = 8,
+                 spill_dir: Optional[str] = None, name: str = "log"):
+        self.n_partitions = n_partitions
+        self._parts: List[List[Any]] = [[] for _ in range(n_partitions)]
+        self._subs: List[List[Callable[[int, int, Any], None]]] = [
+            [] for _ in range(n_partitions)]
+        self._lock = threading.Lock()
+        # per-partition delivery locks: consumers must observe offsets in
+        # order, so append+notify is atomic per partition (notifying outside
+        # any ordering lock would let two racing appends deliver reordered)
+        self._dlocks = [threading.RLock() for _ in range(n_partitions)]
+        self._spill = None
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+            self._spill = [
+                open(os.path.join(spill_dir, f"{name}-p{i}.jsonl"), "a")
+                for i in range(n_partitions)
+            ]
+
+    def append(self, partition: int, record: Any) -> int:
+        """Append; returns the record's offset. Notifies subscribers inline,
+        in offset order (in-process stand-in for the consumer poll loop)."""
+        with self._dlocks[partition]:
+            with self._lock:
+                part = self._parts[partition]
+                offset = len(part)
+                part.append(record)
+                if self._spill is not None:
+                    self._spill[partition].write(
+                        json.dumps(record, default=str) + "\n")
+                    self._spill[partition].flush()
+                subs = list(self._subs[partition])
+            for fn in subs:
+                fn(partition, offset, record)
+        return offset
+
+    def subscribe(self, partition: int,
+                  fn: Callable[[int, int, Any], None],
+                  from_offset: int = 0) -> None:
+        """Register a consumer; replays records from ``from_offset`` first
+        (the rebalance/recovery path)."""
+        with self._dlocks[partition]:
+            with self._lock:
+                backlog = list(self._parts[partition][from_offset:])
+                base = from_offset
+                self._subs[partition].append(fn)
+            for i, rec in enumerate(backlog):
+                fn(partition, base + i, rec)
+
+    def read(self, partition: int, from_offset: int = 0,
+             to_offset: Optional[int] = None) -> List[Any]:
+        with self._lock:
+            return list(self._parts[partition][from_offset:to_offset])
+
+    def size(self, partition: int) -> int:
+        with self._lock:
+            return len(self._parts[partition])

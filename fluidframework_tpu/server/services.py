@@ -1,0 +1,121 @@
+"""Post-sequencing lambdas: Broadcaster, Scriptorium, Scribe, Historian.
+
+Reference counterparts (SURVEY.md §1 server table; mount empty):
+
+- **Broadcaster** — fans sequenced ops out to connected clients (Redis
+  pub/sub → Socket.IO rooms). Here: per-doc subscription registry fed by the
+  sequenced-deltas log.
+- **Scriptorium** — writes sequenced ops to the persistent op store (MongoDB)
+  for catch-up reads. Here: per-doc ordered op store with range reads.
+- **Scribe** — tracks protocol state and converts ``summarize`` ops into
+  ``summaryAck``/``summaryNack``.
+- **Historian/Gitrest** — content-addressed summary storage with a git-like
+  blob/tree API.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+
+
+class Broadcaster:
+    def __init__(self):
+        self._rooms: Dict[str, List[Callable[[SequencedDocumentMessage], None]]] = {}
+        self._lock = threading.Lock()
+
+    def join(self, doc_id: str,
+             listener: Callable[[SequencedDocumentMessage], None]) -> None:
+        with self._lock:
+            self._rooms.setdefault(doc_id, []).append(listener)
+
+    def leave(self, doc_id: str, listener) -> None:
+        with self._lock:
+            room = self._rooms.get(doc_id, [])
+            if listener in room:
+                room.remove(listener)
+
+    def publish(self, msg: SequencedDocumentMessage) -> None:
+        with self._lock:
+            room = list(self._rooms.get(msg.doc_id, []))
+        for listener in room:
+            listener(msg)
+
+
+class Scriptorium:
+    """Durable sequenced-op store, the catch-up read path."""
+
+    def __init__(self):
+        self._ops: Dict[str, List[SequencedDocumentMessage]] = {}
+        self._lock = threading.Lock()
+
+    def store(self, msg: SequencedDocumentMessage) -> None:
+        with self._lock:
+            self._ops.setdefault(msg.doc_id, []).append(msg)
+
+    def get_deltas(self, doc_id: str, from_seq: int = 0,
+                   to_seq: Optional[int] = None
+                   ) -> List[SequencedDocumentMessage]:
+        """Ops with from_seq < seq <= to_seq (the tail-replay range)."""
+        with self._lock:
+            ops = self._ops.get(doc_id, [])
+            return [m for m in ops
+                    if m.seq > from_seq and (to_seq is None or m.seq <= to_seq)]
+
+
+class Historian:
+    """Content-addressed snapshot storage (git-like blobs + refs)."""
+
+    def __init__(self):
+        self._blobs: Dict[str, bytes] = {}
+        self._refs: Dict[str, Tuple[str, int]] = {}  # doc -> (sha, seq)
+        self._lock = threading.Lock()
+
+    def upload_summary(self, doc_id: str, summary: dict, seq: int) -> str:
+        blob = json.dumps(summary, sort_keys=True, default=str).encode()
+        sha = hashlib.sha1(blob).hexdigest()
+        with self._lock:
+            self._blobs[sha] = blob
+            self._refs[doc_id] = (sha, seq)
+        return sha
+
+    def latest_summary(self, doc_id: str
+                       ) -> Tuple[Optional[dict], int, Optional[str]]:
+        """(summary, seq, sha) of the newest accepted summary, or (None, 0,
+        None) for a fresh document."""
+        with self._lock:
+            ref = self._refs.get(doc_id)
+            if ref is None:
+                return None, 0, None
+            sha, seq = ref
+            return json.loads(self._blobs[sha]), seq, sha
+
+    def read_blob(self, sha: str) -> bytes:
+        with self._lock:
+            return self._blobs[sha]
+
+
+class Scribe:
+    """Summary-op protocol: validates summarize ops, emits acks."""
+
+    def __init__(self, historian: Historian):
+        self.historian = historian
+        self.last_summary_seq: Dict[str, int] = {}
+
+    def process(self, msg: SequencedDocumentMessage
+                ) -> Optional[Tuple[MessageType, dict]]:
+        """Returns a (SUMMARY_ACK|SUMMARY_NACK, contents) service message to
+        sequence, or None for non-summary ops."""
+        if msg.type != MessageType.SUMMARIZE:
+            return None
+        sha = (msg.contents or {}).get("handle")
+        if sha is None or sha not in self.historian._blobs:
+            return MessageType.SUMMARY_NACK, {"summaryProposal": msg.seq,
+                                              "reason": "unknown handle"}
+        self.last_summary_seq[msg.doc_id] = msg.seq
+        return MessageType.SUMMARY_ACK, {"summaryProposal": msg.seq,
+                                         "handle": sha}

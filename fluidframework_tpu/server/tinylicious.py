@@ -1,0 +1,171 @@
+"""LocalService: the whole ordering service in one process ("tinylicious").
+
+Reference counterpart: ``tinylicious`` / ``LocalDeltaConnectionServer`` +
+``LocalOrderer`` (SURVEY.md §1, §4): the full Alfred → Kafka → Deli →
+Broadcaster/Scriptorium/Scribe pipeline, in memory, deterministic, for local
+development and integration tests. Unlike ``testing.MockSequencer`` (a flat
+stub), this wires the real lambdas end to end: raw ops flow through the
+partitioned log, Deli stamps them, and the sequenced stream feeds broadcast,
+durable storage, and summary acks — exactly the production topology, minus
+sockets.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+from .deli import DeliSequencer, Nack
+from .oplog import PartitionedLog, partition_of
+from .services import Broadcaster, Historian, Scribe, Scriptorium
+
+
+class DeltaConnection:
+    """One client's connection to one document (reference:
+    IDocumentDeltaConnection): submit ops, receive the sequenced stream."""
+
+    def __init__(self, service: "LocalService", doc_id: str, client_id: int):
+        self.service = service
+        self.doc_id = doc_id
+        self.client_id = client_id
+        self._client_seq = 0
+        self.listeners: List[Callable[[SequencedDocumentMessage], None]] = []
+        self.nacks: List[Nack] = []
+        self.connected = True
+
+    def submit(self, contents: Any, type: MessageType = MessageType.OP,
+               ref_seq: int = 0, address: Optional[str] = None) -> int:
+        assert self.connected, "submit on closed connection"
+        if type != MessageType.NOOP:
+            self._client_seq += 1
+        self.service._ingest(
+            self.doc_id, self.client_id, self._client_seq, ref_seq, type,
+            contents, address)
+        return self._client_seq
+
+    def on_op(self, fn: Callable[[SequencedDocumentMessage], None]) -> None:
+        self.listeners.append(fn)
+
+    def disconnect(self) -> None:
+        if self.connected:
+            self.connected = False
+            self.service._leave(self)
+
+
+class LocalService:
+    """In-process ordering service with the production lambda topology."""
+
+    def __init__(self, n_partitions: int = 4,
+                 spill_dir: Optional[str] = None):
+        self.raw_log = PartitionedLog(n_partitions, spill_dir, "rawdeltas")
+        self.deltas_log = PartitionedLog(n_partitions, spill_dir, "deltas")
+        self.deli = DeliSequencer()
+        self.broadcaster = Broadcaster()
+        self.scriptorium = Scriptorium()
+        self.historian = Historian()
+        self.scribe = Scribe(self.historian)
+        self._next_client = 1
+        self._lock = threading.RLock()
+        self.nacks: List[Nack] = []
+        self._connections: Dict[int, DeltaConnection] = {}
+        # wire the pipeline: raw -> deli -> deltas -> fan-out lambdas
+        for p in range(n_partitions):
+            self.raw_log.subscribe(p, self._deli_consume)
+            self.deltas_log.subscribe(p, self._deltas_consume)
+
+    # ------------------------------------------------------------ front door
+
+    def connect(self, doc_id: str) -> DeltaConnection:
+        """Alfred/Nexus ingress: allocate a client id, sequence the join,
+        open the delta stream."""
+        with self._lock:
+            client_id = self._next_client
+            self._next_client += 1
+            conn = DeltaConnection(self, doc_id, client_id)
+            self._connections[client_id] = conn
+            self.broadcaster.join(doc_id, self._deliver_to(conn))
+            join = self.deli.client_join(doc_id, client_id)
+            self._publish(join)
+        return conn
+
+    def _deliver_to(self, conn: DeltaConnection):
+        def deliver(msg: SequencedDocumentMessage):
+            if conn.connected:
+                for fn in list(conn.listeners):
+                    fn(msg)
+        conn._deliver = deliver
+        return deliver
+
+    def _leave(self, conn: DeltaConnection) -> None:
+        with self._lock:
+            self.broadcaster.leave(conn.doc_id, conn._deliver)
+            leave = self.deli.client_leave(conn.doc_id, conn.client_id)
+            if leave is not None:
+                self._publish(leave)
+
+    # -------------------------------------------------------------- pipeline
+
+    def _ingest(self, doc_id, client_id, client_seq, ref_seq, type, contents,
+                address) -> None:
+        p = partition_of(doc_id, self.raw_log.n_partitions)
+        self.raw_log.append(p, dict(
+            doc_id=doc_id, client_id=client_id, client_seq=client_seq,
+            ref_seq=ref_seq, type=int(type), contents=contents,
+            address=address))
+
+    def _deli_consume(self, partition: int, offset: int, raw: dict) -> None:
+        with self._lock:
+            msg, nack = self.deli.sequence(
+                raw["doc_id"], raw["client_id"], raw["client_seq"],
+                raw["ref_seq"], MessageType(raw["type"]), raw["contents"],
+                raw.get("address"))
+            if nack is not None:
+                self.nacks.append(nack)
+                conn = self._connections.get(nack.client_id)
+                if conn is not None:
+                    conn.nacks.append(nack)
+                return
+            self._publish(msg)
+
+    def _publish(self, msg: SequencedDocumentMessage) -> None:
+        p = partition_of(msg.doc_id, self.deltas_log.n_partitions)
+        self.deltas_log.append(p, msg)
+
+    def _deltas_consume(self, partition: int, offset: int,
+                        msg: SequencedDocumentMessage) -> None:
+        self.scriptorium.store(msg)
+        ack = self.scribe.process(msg)
+        self.broadcaster.publish(msg)
+        if ack is not None:
+            ack_type, contents = ack
+            with self._lock:
+                doc = self.deli._doc(msg.doc_id)
+                doc.seq += 1
+                service_msg = SequencedDocumentMessage(
+                    doc_id=msg.doc_id, client_id=-1, client_seq=0,
+                    ref_seq=doc.seq, seq=doc.seq, min_seq=doc.min_seq,
+                    type=ack_type, contents=contents)
+                self._publish(service_msg)
+
+    # ----------------------------------------------------------- storage API
+
+    def get_deltas(self, doc_id: str, from_seq: int = 0,
+                   to_seq: Optional[int] = None):
+        return self.scriptorium.get_deltas(doc_id, from_seq, to_seq)
+
+    def upload_summary(self, doc_id: str, summary: dict, seq: int) -> str:
+        return self.historian.upload_summary(doc_id, summary, seq)
+
+    def latest_summary(self, doc_id: str):
+        return self.historian.latest_summary(doc_id)
+
+    # --------------------------------------------------------- fault testing
+
+    def checkpoint(self) -> dict:
+        return self.deli.checkpoint()
+
+    def restart_sequencer(self, checkpoint: dict) -> None:
+        """Simulate a Deli partition restart from its checkpoint."""
+        with self._lock:
+            self.deli = DeliSequencer.restore(checkpoint)

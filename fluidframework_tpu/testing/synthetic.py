@@ -1,0 +1,65 @@
+"""Synthetic op-corpus generation for benchmarks (BASELINE.md: no reference
+corpora exist on disk — configs #1–#5 are generated from seeds).
+
+The generator is fully vectorized: every doc follows the same
+insert/insert/insert/remove cadence (so per-op document lengths are a known
+deterministic sequence), while positions vary randomly per (doc, op). This
+produces position-resolution + split + tombstone work identical in kind to a
+typing-trace replay, at corpus scale, without a slow per-op host loop.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..ops.schema import OpKind
+
+INS_LEN = 4
+RM_LEN = 2
+
+
+def typing_storm(n_docs: int, n_ops: int, seed: int = 0,
+                 start_seq: int = 1) -> Tuple[dict, int]:
+    """Dense (D, O) op planes for a synthetic multi-doc typing storm.
+
+    Cadence per doc: 3 inserts of INS_LEN chars, then one remove of RM_LEN.
+    Returns (planes dict, next_seq). Sequence numbers are assigned
+    round-robin across docs in op-index order, matching a fair sequencer.
+    """
+    rng = np.random.default_rng(seed)
+    D, O = n_docs, n_ops
+
+    lengths = np.zeros(O + 1, dtype=np.int64)
+    kinds = np.zeros(O, dtype=np.int32)
+    for k in range(O):
+        if k % 4 < 3 or lengths[k] < RM_LEN:
+            kinds[k] = OpKind.STR_INSERT
+            lengths[k + 1] = lengths[k] + INS_LEN
+        else:
+            kinds[k] = OpKind.STR_REMOVE
+            lengths[k + 1] = lengths[k] - RM_LEN
+
+    kind = np.broadcast_to(kinds, (D, O)).copy()
+    a0 = np.zeros((D, O), np.int32)
+    a1 = np.zeros((D, O), np.int32)
+    a2 = np.zeros((D, O), np.int32)
+    for k in range(O):
+        if kinds[k] == OpKind.STR_INSERT:
+            a0[:, k] = rng.integers(0, lengths[k] + 1, size=D)
+            a1[:, k] = INS_LEN
+            a2[:, k] = k + 1  # payload handle (synthetic)
+        else:
+            a0[:, k] = rng.integers(0, lengths[k] - RM_LEN + 1, size=D)
+            a1[:, k] = a0[:, k] + RM_LEN
+
+    # global seq: op k of doc d -> start + k*D + d (round-robin sequencer)
+    d_idx = np.arange(D, dtype=np.int64)[:, None]
+    k_idx = np.arange(O, dtype=np.int64)[None, :]
+    seq = (start_seq + k_idx * D + d_idx).astype(np.int32)
+    ref_seq = np.maximum(seq - D, 0).astype(np.int32)  # saw own previous op
+    client = np.zeros((D, O), np.int32)
+    planes = dict(kind=kind, a0=a0, a1=a1, a2=a2, seq=seq, client=client,
+                  ref_seq=ref_seq)
+    return planes, int(start_seq + D * O)

@@ -1,15 +1,23 @@
-"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+"""Test configuration: force an 8-device virtual CPU mesh for all tests.
 
-Multi-chip hardware is not available in this environment; per the build
-instructions, sharding/collective paths are validated on a virtual CPU mesh
-(``--xla_force_host_platform_device_count=8``). Must run before jax import.
+Multi-chip hardware is not available in this environment; sharding and
+collective paths are validated on a virtual CPU mesh
+(``--xla_force_host_platform_device_count=8``).
+
+Note: the environment's sitecustomize imports jax at interpreter start, which
+snapshots JAX_PLATFORMS=axon (the TPU tunnel) into jax.config — env vars set
+afterwards are ignored. ``jax.config.update`` + XLA_FLAGS before first backend
+use is the reliable override.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

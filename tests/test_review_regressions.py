@@ -1,0 +1,78 @@
+"""Regressions pinned from code-review findings."""
+
+import numpy as np
+
+from fluidframework_tpu.core.protocol import MessageType
+from fluidframework_tpu.models import SharedMap, SharedMatrix
+from fluidframework_tpu.server.deli import DeliSequencer
+from fluidframework_tpu.testing.mocks import MockSequencer, create_connected_dds
+
+
+def test_deli_clamps_future_ref_seq():
+    """An inflated ref_seq must not drive MSN past seq and brick the doc."""
+    d = DeliSequencer()
+    d.client_join("doc", 1)
+    msg, nack = d.sequence("doc", 1, 1, 999_999, MessageType.OP, {})
+    assert nack is None and msg.min_seq <= msg.seq
+    assert d.sequence("doc", 1, 2, msg.seq, MessageType.OP, {})[1] is None
+
+
+def test_map_summary_keeps_acked_value_under_pending_shadow():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedMap, "m")
+    b = create_connected_dds(seqr, SharedMap, "m")
+    a.set("x", 1)
+    seqr.process_all_messages()
+    a.set("x", 2)  # in flight: summary must still carry acked x=1
+    summary = a.summarize()
+    assert summary["data"] == {"x": 1}
+    seqr.process_all_messages()
+    assert a.summarize()["data"] == {"x": 2}
+
+
+def test_matrix_summary_excludes_pending_and_keeps_fww_provenance():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedMatrix, "m")
+    b = create_connected_dds(seqr, SharedMatrix, "m")
+    a.insert_rows(0, 1)
+    a.insert_cols(0, 1)
+    a.switch_set_cell_policy()
+    seqr.process_all_messages()
+    a.set_cell(0, 0, "acked")
+    seqr.process_all_messages()
+    a.set_cell(0, 0, "pending")  # in flight
+    summary = a.summarize()
+    assert summary["grid"][0][0][0] == "acked"
+    assert summary["fww"] is True
+    # a loaded replica keeps FWW provenance: a write whose ref predates the
+    # acked value must still be rejected
+    c = SharedMatrix("m2", 99)
+    c.load_core(summary)
+    assert c.cell_seq != {} and c.get_cell(0, 0) == "acked"
+
+
+def test_zamboni_slide_with_coalesce_in_same_pass():
+    """Refs on a dead segment must not slide onto a segment the same zamboni
+    pass coalesces away (confirmed review repro)."""
+    from fluidframework_tpu.models import SharedString
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedString, "s")
+    b = create_connected_dds(seqr, SharedString, "s")
+    a.insert_text(0, "abcd")     # one insert -> coalescible halves
+    seqr.process_all_messages()
+    a.insert_text(2, "X")        # splits abcd -> ab|X|cd
+    seqr.process_all_messages()
+    a.insert_text(5, "ZZ")
+    seqr.process_all_messages()
+    iid = a.get_interval_collection("c").add(5, 6)   # anchored on ZZ
+    seqr.process_all_messages()
+    a.remove_text(2, 3)          # remove X -> ab|cd adjacency restored
+    a.remove_text(4, 6)          # remove ZZ (the anchor)
+    seqr.process_all_messages()
+    for r in (a, b):
+        seqr.submit(r, {}, type=MessageType.NOOP)
+    seqr.process_all_messages()  # MSN catches up -> zamboni w/ coalesce
+    # endpoints must still resolve on every replica (no dangling anchors)
+    d1 = a.get_interval_collection("c").digest()
+    d2 = b.get_interval_collection("c").digest()
+    assert d1 == d2
