@@ -1,1 +1,39 @@
-"""(populated as the build proceeds)"""
+"""Drivers (L1): service adapters behind the driver contracts.
+
+Reference counterpart: ``packages/drivers/`` — SURVEY.md §1 L1, §2.12.
+"""
+
+from .definitions import (
+    DeltaStorageService,
+    DeltaStreamConnection,
+    DocumentService,
+    DocumentServiceFactory,
+    SummaryStorageService,
+)
+from .file_driver import (
+    FileDocumentService,
+    read_latest_summary,
+    read_ops,
+    write_document,
+)
+from .local_driver import LocalDocumentService, LocalDocumentServiceFactory
+from .replay_driver import (
+    ReadonlyConnectionError,
+    ReplayDocumentService,
+)
+
+__all__ = [
+    "DeltaStorageService",
+    "DeltaStreamConnection",
+    "DocumentService",
+    "DocumentServiceFactory",
+    "SummaryStorageService",
+    "FileDocumentService",
+    "read_latest_summary",
+    "read_ops",
+    "write_document",
+    "LocalDocumentService",
+    "LocalDocumentServiceFactory",
+    "ReadonlyConnectionError",
+    "ReplayDocumentService",
+]

@@ -1,0 +1,99 @@
+"""File driver: persist and load a document (summary + op stream) on disk.
+
+Reference counterpart: ``@fluidframework/file-driver`` + the ``fetch-tool``
+storage format (SURVEY.md §2.12, §2.18): a document directory holding the op
+stream as JSONL plus summary snapshots, so traces can be recorded from any
+live service and replayed later (``tools/fetch.py`` writes this format,
+``tools/replay.py`` reads it back through ``ReplayDocumentService``).
+
+Layout:  <dir>/ops.jsonl          one SequencedDocumentMessage per line
+         <dir>/summary-<seq>.json summary tree captured at <seq>
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import List, Optional, Tuple
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+from . import definitions as defs
+from .replay_driver import (
+    ReplayDeltaStorage, ReplayDeltaStreamConnection, ReplaySummaryStorage,
+)
+
+
+def _msg_to_json(m: SequencedDocumentMessage) -> dict:
+    return dict(doc_id=m.doc_id, client_id=m.client_id,
+                client_seq=m.client_seq, ref_seq=m.ref_seq, seq=m.seq,
+                min_seq=m.min_seq, type=int(m.type), contents=m.contents,
+                metadata=m.metadata, address=m.address)
+
+
+def _msg_from_json(d: dict) -> SequencedDocumentMessage:
+    return SequencedDocumentMessage(
+        doc_id=d["doc_id"], client_id=d["client_id"],
+        client_seq=d["client_seq"], ref_seq=d["ref_seq"], seq=d["seq"],
+        min_seq=d["min_seq"], type=MessageType(d["type"]),
+        contents=d.get("contents"), metadata=d.get("metadata"),
+        address=d.get("address"))
+
+
+def write_document(dir_path: str, ops: List[SequencedDocumentMessage],
+                   summaries: Optional[List[Tuple[dict, int]]] = None) -> None:
+    """Record a document to disk (the fetch-tool write path)."""
+    os.makedirs(dir_path, exist_ok=True)
+    with open(os.path.join(dir_path, "ops.jsonl"), "w") as f:
+        for m in sorted(ops, key=lambda m: m.seq):
+            f.write(json.dumps(_msg_to_json(m)) + "\n")
+    for summary, seq in summaries or []:
+        with open(os.path.join(dir_path, f"summary-{seq}.json"), "w") as f:
+            json.dump(summary, f)
+
+
+def read_ops(dir_path: str) -> List[SequencedDocumentMessage]:
+    path = os.path.join(dir_path, "ops.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [_msg_from_json(json.loads(line)) for line in f if line.strip()]
+
+
+def read_latest_summary(dir_path: str,
+                        max_seq: Optional[int] = None
+                        ) -> Optional[Tuple[dict, int]]:
+    best: Optional[Tuple[dict, int]] = None
+    for path in glob.glob(os.path.join(dir_path, "summary-*.json")):
+        seq = int(os.path.basename(path)[len("summary-"):-len(".json")])
+        if max_seq is not None and seq > max_seq:
+            continue
+        if best is None or seq > best[1]:
+            with open(path) as f:
+                best = (json.load(f), seq)
+    return best
+
+
+class FileDocumentService(defs.DocumentService):
+    """Load a recorded document directory (read-only, like replay-driver but
+    from the on-disk format)."""
+
+    def __init__(self, dir_path: str, doc_id: Optional[str] = None,
+                 to_seq: Optional[int] = None):
+        ops = read_ops(dir_path)
+        self.doc_id = doc_id or (ops[0].doc_id if ops else
+                                 os.path.basename(dir_path))
+        self._delta_storage = ReplayDeltaStorage(ops, to_seq)
+        self._summary_storage = ReplaySummaryStorage(
+            read_latest_summary(dir_path, max_seq=to_seq))
+
+    def connect_to_delta_stream(self) -> ReplayDeltaStreamConnection:
+        return ReplayDeltaStreamConnection()
+
+    @property
+    def delta_storage(self) -> ReplayDeltaStorage:
+        return self._delta_storage
+
+    @property
+    def summary_storage(self) -> ReplaySummaryStorage:
+        return self._summary_storage

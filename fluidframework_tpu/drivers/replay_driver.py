@@ -1,0 +1,99 @@
+"""Replay driver: re-run a recorded op stream against current code.
+
+Reference counterpart: ``@fluidframework/replay-driver`` (SURVEY.md §2.12,
+§4 "Replay" tier): a read-only DocumentService whose delta storage serves a
+recorded sequenced-op stream and whose delta stream never accepts submits.
+Used by the replay tool (``tools/replay.py``) for regression + perf runs over
+recorded traces (BASELINE config #1 is exactly this).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+from . import definitions as defs
+
+
+class ReadonlyConnectionError(RuntimeError):
+    pass
+
+
+class ReplayDeltaStreamConnection(defs.DeltaStreamConnection):
+    """A dead-end delta stream: the recording is already fully sequenced, so
+    there is nothing live to connect to and submits are an error."""
+
+    client_id = -1
+    connected = True
+
+    def __init__(self):
+        self._listeners: List[Callable[[SequencedDocumentMessage], None]] = []
+
+    def submit(self, contents: Any, type: MessageType = MessageType.OP,
+               ref_seq: int = 0, address: Optional[str] = None) -> int:
+        raise ReadonlyConnectionError("replay driver is read-only")
+
+    def on_op(self, fn: Callable[[SequencedDocumentMessage], None]) -> None:
+        self._listeners.append(fn)
+
+    def on_nack(self, fn: Callable[[Any], None]) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        self.connected = False
+
+    def push(self, msg: SequencedDocumentMessage) -> None:
+        """Feed one recorded op through the live-stream path (lets the replay
+        tool exercise the exact inbound pipeline, not just catch-up)."""
+        for fn in list(self._listeners):
+            fn(msg)
+
+
+class ReplayDeltaStorage(defs.DeltaStorageService):
+    def __init__(self, ops: List[SequencedDocumentMessage],
+                 to_seq: Optional[int] = None):
+        self._ops = sorted(ops, key=lambda m: m.seq)
+        self._to_seq = to_seq
+
+    def get_deltas(self, from_seq: int = 0, to_seq: Optional[int] = None
+                   ) -> List[SequencedDocumentMessage]:
+        hi = to_seq if to_seq is not None else self._to_seq
+        return [m for m in self._ops
+                if m.seq > from_seq and (hi is None or m.seq <= hi)]
+
+
+class ReplaySummaryStorage(defs.SummaryStorageService):
+    def __init__(self, summary: Optional[Tuple[dict, int]] = None):
+        self._summary = summary
+
+    def get_latest_summary(self) -> Optional[Tuple[dict, int]]:
+        return self._summary
+
+    def upload_summary(self, summary: dict, seq: int) -> str:
+        raise ReadonlyConnectionError("replay driver is read-only")
+
+
+class ReplayDocumentService(defs.DocumentService):
+    """Serve a recording: optional starting summary + the sequenced op tail.
+
+    ``to_seq`` caps the visible stream — replaying a prefix of history is how
+    the replay tool bisects regressions.
+    """
+
+    def __init__(self, doc_id: str, ops: List[SequencedDocumentMessage],
+                 summary: Optional[Tuple[dict, int]] = None,
+                 to_seq: Optional[int] = None):
+        self.doc_id = doc_id
+        self._delta_storage = ReplayDeltaStorage(ops, to_seq)
+        self._summary_storage = ReplaySummaryStorage(summary)
+
+    def connect_to_delta_stream(self) -> ReplayDeltaStreamConnection:
+        return ReplayDeltaStreamConnection()
+
+    @property
+    def delta_storage(self) -> ReplayDeltaStorage:
+        return self._delta_storage
+
+    @property
+    def summary_storage(self) -> ReplaySummaryStorage:
+        return self._summary_storage

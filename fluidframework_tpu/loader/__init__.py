@@ -1,1 +1,21 @@
-"""(populated as the build proceeds)"""
+"""Loader (L2): container lifecycle, delta manager, protocol/quorum.
+
+Reference counterpart: ``@fluidframework/container-loader`` — SURVEY.md §2.10.
+"""
+
+from .container import Container, ContainerState, Loader
+from .delta_manager import ConnectionState, DeltaManager
+from .delta_queue import DeltaQueue
+from .protocol import ProtocolHandler, Quorum, QuorumProposal
+
+__all__ = [
+    "Container",
+    "ContainerState",
+    "Loader",
+    "ConnectionState",
+    "DeltaManager",
+    "DeltaQueue",
+    "ProtocolHandler",
+    "Quorum",
+    "QuorumProposal",
+]

@@ -10,6 +10,7 @@
 // this image).
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <unordered_map>
@@ -132,12 +133,68 @@ int64_t deli_doc_min_seq(void* h, const char* doc_id) {
 // --------------------------------------------------------------- checkpoint
 // Text format, one doc per line:
 //   doc_id\tseq\tmin_seq\t[client:last_cs:ref_seq,...]\n
+// Doc ids are caller-controlled strings: the delimiters ('\t', '\n') and the
+// escape char ('%') are percent-encoded so an adversarial id cannot inject
+// rows, and restore parses with strtoll (no exceptions across the C ABI).
+
+}  // extern "C"
+
+namespace {
+
+std::string encode_doc_id(const std::string& id) {
+  std::string out;
+  out.reserve(id.size());
+  for (char c : id) {
+    if (c == '%' || c == '\t' || c == '\n') {
+      static const char* hex = "0123456789ABCDEF";
+      out += '%';
+      out += hex[(static_cast<unsigned char>(c) >> 4) & 0xF];
+      out += hex[static_cast<unsigned char>(c) & 0xF];
+    } else {
+      out += c;
+    }
+  }
+  return out;
+}
+
+std::string decode_doc_id(const std::string& enc) {
+  auto nib = [](char c) -> int {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    return -1;
+  };
+  std::string out;
+  out.reserve(enc.size());
+  for (size_t i = 0; i < enc.size(); ++i) {
+    if (enc[i] == '%' && i + 2 < enc.size()) {
+      const int hi = nib(enc[i + 1]);
+      const int lo = nib(enc[i + 2]);
+      if (hi >= 0 && lo >= 0) {
+        out += static_cast<char>((hi << 4) | lo);
+        i += 2;
+        continue;
+      }
+    }
+    out += enc[i];
+  }
+  return out;
+}
+
+// exception-free integer parse; returns 0 on malformed input
+int64_t parse_i64(const std::string& s) {
+  return std::strtoll(s.c_str(), nullptr, 10);
+}
+
+}  // namespace
+
+extern "C" {
 
 int64_t deli_checkpoint(void* h, char* buf, int64_t cap) {
   auto* deli = static_cast<Deli*>(h);
   std::string out;
   for (const auto& kv : deli->docs) {
-    out += kv.first;
+    out += encode_doc_id(kv.first);
     out += '\t';
     out += std::to_string(kv.second.seq);
     out += '\t';
@@ -177,8 +234,8 @@ void* deli_restore(const char* buf, int64_t len) {
       continue;
     }
     DocState doc;
-    doc.seq = std::stoll(line.substr(t1 + 1, t2 - t1 - 1));
-    doc.min_seq = std::stoll(line.substr(t2 + 1, t3 - t2 - 1));
+    doc.seq = parse_i64(line.substr(t1 + 1, t2 - t1 - 1));
+    doc.min_seq = parse_i64(line.substr(t2 + 1, t3 - t2 - 1));
     std::string clients = line.substr(t3 + 1);
     size_t cpos = 0;
     while (cpos < clients.size()) {
@@ -189,14 +246,15 @@ void* deli_restore(const char* buf, int64_t len) {
       size_t c2 = entry.find(':', c1 + 1);
       if (c1 != std::string::npos && c2 != std::string::npos) {
         ClientState cs;
-        cs.last_client_seq = std::stoi(entry.substr(c1 + 1, c2 - c1 - 1));
-        cs.ref_seq = std::stoi(entry.substr(c2 + 1));
-        doc.clients[std::stoi(entry.substr(0, c1))] = cs;
+        cs.last_client_seq =
+            static_cast<int32_t>(parse_i64(entry.substr(c1 + 1, c2 - c1 - 1)));
+        cs.ref_seq = static_cast<int32_t>(parse_i64(entry.substr(c2 + 1)));
+        doc.clients[static_cast<int32_t>(parse_i64(entry.substr(0, c1)))] = cs;
       }
       if (comma == std::string::npos) break;
       cpos = comma + 1;
     }
-    deli->docs[line.substr(0, t1)] = doc;
+    deli->docs[decode_doc_id(line.substr(0, t1))] = doc;
   }
   return deli;
 }

@@ -74,8 +74,7 @@ def apply_map_batch(state: MapState, kind: jax.Array, a0: jax.Array,
     # last relevant key-op per (doc, key): max op index among set/delete ops
     # targeting that key after the last clear
     key_onehot = a0[:, :, None] == jnp.arange(n_keys)[None, None, :]  # (D,O,K)
-    relevant = ((is_set | is_del) & (o_idx[None, :] > -1)
-                & (o_idx[None, :] > last_clear[:, None]))
+    relevant = (is_set | is_del) & (o_idx[None, :] > last_clear[:, None])
     cand = jnp.where(relevant[:, :, None] & key_onehot, o_idx[None, :, None], -1)
     last_op = jnp.max(cand, axis=1)                              # (D, K)
 

@@ -63,10 +63,10 @@ class TensorStringStore:
                     handle = self._payload(_MARKER, "")
                     length = 1
                 else:
+                    if not op["text"]:
+                        continue  # empty insert: no segment anywhere
                     handle = self._payload(_TEXT, op["text"])
                     length = len(op["text"])
-                if length == 0:
-                    continue  # empty insert: no segment anywhere
                 rec = (int(OpKind.STR_INSERT), op["pos"], length, handle,
                        msg.seq, cl, msg.ref_seq)
             elif op["mt"] == "remove":

@@ -32,11 +32,12 @@ class PartitionedLog:
         self._parts: List[List[Any]] = [[] for _ in range(n_partitions)]
         self._subs: List[List[Callable[[int, int, Any], None]]] = [
             [] for _ in range(n_partitions)]
-        self._lock = threading.Lock()
-        # per-partition delivery locks: consumers must observe offsets in
-        # order, so append+notify is atomic per partition (notifying outside
-        # any ordering lock would let two racing appends deliver reordered)
-        self._dlocks = [threading.RLock() for _ in range(n_partitions)]
+        # per-partition locks: each partition's list, spill handle, and
+        # subscriber list are independent — appends on different partitions
+        # never contend (the Kafka-partition parallelism this log models).
+        # The lock is reentrant and held across append+notify so consumers
+        # observe offsets in order.
+        self._plocks = [threading.RLock() for _ in range(n_partitions)]
         self._spill = None
         if spill_dir is not None:
             os.makedirs(spill_dir, exist_ok=True)
@@ -48,17 +49,15 @@ class PartitionedLog:
     def append(self, partition: int, record: Any) -> int:
         """Append; returns the record's offset. Notifies subscribers inline,
         in offset order (in-process stand-in for the consumer poll loop)."""
-        with self._dlocks[partition]:
-            with self._lock:
-                part = self._parts[partition]
-                offset = len(part)
-                part.append(record)
-                if self._spill is not None:
-                    self._spill[partition].write(
-                        json.dumps(record, default=str) + "\n")
-                    self._spill[partition].flush()
-                subs = list(self._subs[partition])
-            for fn in subs:
+        with self._plocks[partition]:
+            part = self._parts[partition]
+            offset = len(part)
+            part.append(record)
+            if self._spill is not None:
+                self._spill[partition].write(
+                    json.dumps(record, default=str) + "\n")
+                self._spill[partition].flush()
+            for fn in list(self._subs[partition]):
                 fn(partition, offset, record)
         return offset
 
@@ -67,19 +66,23 @@ class PartitionedLog:
                   from_offset: int = 0) -> None:
         """Register a consumer; replays records from ``from_offset`` first
         (the rebalance/recovery path)."""
-        with self._dlocks[partition]:
-            with self._lock:
-                backlog = list(self._parts[partition][from_offset:])
-                base = from_offset
-                self._subs[partition].append(fn)
+        with self._plocks[partition]:
+            backlog = list(self._parts[partition][from_offset:])
+            self._subs[partition].append(fn)
             for i, rec in enumerate(backlog):
-                fn(partition, base + i, rec)
+                fn(partition, from_offset + i, rec)
+
+    def close(self) -> None:
+        if self._spill is not None:
+            for f in self._spill:
+                f.close()
+            self._spill = None
 
     def read(self, partition: int, from_offset: int = 0,
              to_offset: Optional[int] = None) -> List[Any]:
-        with self._lock:
+        with self._plocks[partition]:
             return list(self._parts[partition][from_offset:to_offset])
 
     def size(self, partition: int) -> int:
-        with self._lock:
+        with self._plocks[partition]:
             return len(self._parts[partition])

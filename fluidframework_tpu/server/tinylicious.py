@@ -162,6 +162,10 @@ class LocalService:
 
     # --------------------------------------------------------- fault testing
 
+    def close(self) -> None:
+        self.raw_log.close()
+        self.deltas_log.close()
+
     def checkpoint(self) -> dict:
         return self.deli.checkpoint()
 

@@ -89,3 +89,21 @@ def test_native_batch_stamping():
     assert (seqs > 0).all()
     assert list(seqs) == list(range(3, n + 3))  # dense total order
     assert (np.diff(mins) >= 0).all()           # MSN monotone
+
+
+def test_checkpoint_hostile_doc_ids():
+    """Doc ids containing the checkpoint delimiters must roundtrip (they are
+    percent-encoded in the blob) and malformed blobs must not crash."""
+    nat = native_deli.NativeDeli()
+    hostile = "doc\twith\ndelims%and%more"
+    nat.client_join(hostile, 1)
+    nat.client_join("plain", 2)
+    nat.sequence(hostile, 1, 1, 1)
+    blob = nat.checkpoint()
+    restored = native_deli.NativeDeli.restore(blob)
+    assert restored.doc_seq(hostile) == nat.doc_seq(hostile)
+    assert restored.doc_seq("plain") == nat.doc_seq("plain")
+    # sequencing continues on the hostile doc with dedupe intact
+    assert restored.sequence(hostile, 1, 1, 1)[2] == NackReason.DUPLICATE
+    # garbage blobs parse without raising (and without crashing the process)
+    native_deli.NativeDeli.restore(b"not\ta\tvalid\nblob\x00\xff\t\t\t\n")
