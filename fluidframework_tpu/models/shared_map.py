@@ -139,6 +139,15 @@ class SharedMap(SharedObject):
     def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
         self.kernel.process(msg.contents, local)
 
+    def apply_stashed_op(self, contents: dict) -> None:
+        kind = contents["op"]
+        if kind == "set":
+            self.kernel.set_local(contents["key"], contents["value"])
+        elif kind == "delete":
+            self.kernel.delete_local(contents["key"])
+        elif kind == "clear":
+            self.kernel.clear_local()
+
     def summarize(self) -> dict:
         # the acked shadow: never contains optimistic local values, and keeps
         # the sequenced value even while a local op for the key is in flight

@@ -58,6 +58,32 @@ class SharedObject:
         self.last_processed_seq = msg.seq
         self.on_min_seq(msg.min_seq)
 
+    def deliver(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        """Runtime-path delivery (datastore routing decided the address and
+        locality). Unlike ``apply_msg``, equal sequence numbers are allowed:
+        every op of a grouped batch shares its envelope's seq (§2.8)."""
+        assert msg.seq >= self.last_processed_seq, "ops must arrive in seq order"
+        self.process_core(msg, local)
+        self.last_processed_seq = msg.seq
+        self.on_min_seq(msg.min_seq)
+
+    def rebase_op(self, contents: dict) -> Optional[dict]:
+        """Rebase one pending local op for resubmission after reconnect
+        (reference: SharedObject.reSubmit). Returns the contents to resend —
+        unchanged by default, which is correct for position-independent ops
+        (map/counter/register...); sequence DDSes override to re-resolve
+        positions against the current state. Return None to drop the op."""
+        return contents
+
+    def apply_stashed_op(self, contents: dict) -> None:
+        """Re-apply a stashed (previously submitted, never sequenced) local
+        op during offline rehydrate (reference: applyStashedOp): mutate the
+        optimistic local state + pending bookkeeping as if the user had just
+        made the edit, WITHOUT submitting — the runtime resubmits on
+        connect."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support stashed ops yet")
+
     def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
         raise NotImplementedError
 

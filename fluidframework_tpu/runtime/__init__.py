@@ -1,1 +1,26 @@
-"""(populated as the build proceeds)"""
+"""Container runtime layer (reference: @fluidframework/container-runtime,
+datastore, id-compressor — SURVEY.md §2.8/§2.9/§2.11)."""
+
+from .container_runtime import (
+    ContainerRuntime,
+    ContainerRuntimeOptions,
+    DEFAULT_DATASTORE,
+)
+from .datastore import FluidDataStoreRuntime
+from .id_compressor import IdCompressor, IdCreationRange, stable_id
+from .outbox import Outbox
+from .pending_state import PendingStateManager
+from .remote_message_processor import RemoteMessageProcessor
+
+__all__ = [
+    "ContainerRuntime",
+    "ContainerRuntimeOptions",
+    "DEFAULT_DATASTORE",
+    "FluidDataStoreRuntime",
+    "IdCompressor",
+    "IdCreationRange",
+    "stable_id",
+    "Outbox",
+    "PendingStateManager",
+    "RemoteMessageProcessor",
+]

@@ -1,0 +1,382 @@
+"""ContainerRuntime: op routing, batching, datastore lifecycle, pending state.
+
+Reference counterpart: ``ContainerRuntime`` in
+``@fluidframework/container-runtime`` (SURVEY.md §2.8, §3.2–3.3; mount
+empty). This is the layer between the loader (``loader/container.py``) and
+the datastores/DDSes (``runtime/datastore.py``, ``models/``):
+
+- **inbound** (§3.2): ``process`` expands each sequenced wire message
+  (chunk reassembly → decompression → ungrouping via
+  ``RemoteMessageProcessor``), acks pending local records, routes runtime
+  messages by outer address to the owning datastore;
+- **outbound** (§3.3): ``submit`` goes through the ``Outbox`` (batching →
+  grouped batching → compression → chunking); flush mode "immediate" sends
+  after every op, "turn" batches until the host loop calls ``flush()``;
+- **datastore lifecycle**: ``create_data_store`` announces new datastores
+  via attach ops; channels created on an attached datastore are announced
+  with channel-attach ops; remote replicas realize both lazily from the
+  shipped summaries;
+- **pending state** (§5.3): every local runtime message is recorded until
+  its sequenced echo; on reconnect the records are resubmitted through the
+  channels (rebase hook); ``get_pending_local_state``/``load(...,
+  pending_blob)`` implement stash/rehydrate for offline resume;
+- **id compression** (§2.11): creation ranges ride the op stream ahead of
+  each flushed batch and finalize in sequence order on every replica.
+
+Factory wiring: ``ContainerRuntime.factory(registry)`` returns the
+``RuntimeFactory`` that ``loader.Container.load`` expects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..models.shared_object import ChannelRegistry, default_registry
+from .datastore import FluidDataStoreRuntime
+from .id_compressor import IdCompressor, IdCreationRange
+from .outbox import Outbox
+from .pending_state import PendingStateManager
+from .remote_message_processor import RemoteMessageProcessor
+
+# runtime-level op kinds (the "type" discriminator of runtime message
+# contents that are NOT address-routed envelopes)
+ATTACH = "attach"
+ATTACH_CHANNEL = "attachChannel"
+ID_RANGE = "idRange"
+WITH_METADATA = "withMeta"     # wire wrapper carrying per-op metadata
+
+DEFAULT_DATASTORE = "default"
+
+
+@dataclasses.dataclass
+class ContainerRuntimeOptions:
+    """Reference: IContainerRuntimeOptions (summary/compression/grouping
+    knobs) — SURVEY.md §5.6."""
+
+    flush_mode: str = "immediate"          # "immediate" | "turn"
+    grouped_batching: bool = True
+    compression_threshold: int = 4096
+    max_op_size: int = 16384
+    enable_id_compressor: bool = True
+
+
+class ContainerRuntime:
+    def __init__(self, submit_fn: Callable[..., Any],
+                 registry: Optional[ChannelRegistry] = None,
+                 options: Optional[ContainerRuntimeOptions] = None,
+                 client_id: Optional[int] = None):
+        """``submit_fn(contents, metadata)`` sends one wire op (the loader
+        container's ``submit``, with metadata folded into contents at the
+        wire layer — see ``_wire_submit``)."""
+        self.registry = registry or default_registry()
+        self.options = options or ContainerRuntimeOptions()
+        self.client_id = client_id if client_id is not None else -1
+        self.connected = client_id is not None
+        self.datastores: Dict[str, FluidDataStoreRuntime] = {}
+        self._pending_ds_summaries: Dict[str, dict] = {}
+        self._deferred_stash: List[dict] = []
+        self.pending = PendingStateManager()
+        self.inbound = RemoteMessageProcessor()
+        self.id_compressor = IdCompressor() \
+            if self.options.enable_id_compressor else None
+        self._wire_submit = submit_fn
+        self.outbox = Outbox(
+            self._send_wire_op,
+            grouped_batching=self.options.grouped_batching,
+            compression_threshold=self.options.compression_threshold,
+            max_op_size=self.options.max_op_size)
+        self.last_seq = 0
+        self.min_seq = 0
+        self._listeners: Dict[str, List[Callable]] = {}
+
+    # ---------------------------------------------------------------- factory
+
+    @classmethod
+    def factory(cls, registry: Optional[ChannelRegistry] = None,
+                options: Optional[ContainerRuntimeOptions] = None,
+                pending_blob: Optional[list] = None):
+        """A ``RuntimeFactory`` for ``loader.Container.load`` (reference:
+        the code-proposal → runtime-factory boundary)."""
+        def make(container, runtime_summary):
+            rt = cls(container.submit, registry=registry, options=options)
+            if runtime_summary:
+                rt._load_summary(runtime_summary)
+            if pending_blob:
+                rt._rehydrate(pending_blob)
+            return rt
+        return make
+
+    def _on_channel_create(self, ds: FluidDataStoreRuntime,
+                           channel) -> None:
+        """Announce a locally-created channel to remote replicas
+        (reference: channel attach ops)."""
+        self._submit_runtime_op({
+            "type": ATTACH_CHANNEL, "address": ds.id,
+            "id": channel.id, "summary": channel.summarize()})
+
+    def on(self, event: str, fn: Callable) -> None:
+        self._listeners.setdefault(event, []).append(fn)
+
+    def _emit(self, event: str, *args) -> None:
+        for fn in self._listeners.get(event, []):
+            fn(*args)
+
+    # ------------------------------------------------------------- datastores
+
+    def create_data_store(self, ds_id: str = DEFAULT_DATASTORE
+                          ) -> FluidDataStoreRuntime:
+        """Create + attach a datastore (announced via an attach op so every
+        replica instantiates it — reference: createDataStore + attach)."""
+        assert ds_id not in self.datastores \
+            and ds_id not in self._pending_ds_summaries, \
+            f"datastore {ds_id!r} already exists"
+        ds = self._instantiate(ds_id)
+        self.datastores[ds_id] = ds
+        self._submit_runtime_op({"type": ATTACH, "id": ds_id,
+                                 "summary": ds.summarize()})
+        return ds
+
+    def get_data_store(self, ds_id: str = DEFAULT_DATASTORE
+                       ) -> FluidDataStoreRuntime:
+        """Realize-on-demand from the loaded summary (reference:
+        resolveHandle / getRootDataStore)."""
+        if ds_id not in self.datastores:
+            summary = self._pending_ds_summaries.pop(ds_id)
+            ds = FluidDataStoreRuntime.load(
+                ds_id, self.registry, self.client_id,
+                self._make_ds_submit(ds_id), summary,
+                on_channel_create=self._on_channel_create)
+            self.datastores[ds_id] = ds
+        return self.datastores[ds_id]
+
+    def has_data_store(self, ds_id: str) -> bool:
+        return ds_id in self.datastores or ds_id in self._pending_ds_summaries
+
+    def data_store_ids(self):
+        return sorted(set(self.datastores) | set(self._pending_ds_summaries))
+
+    def _instantiate(self, ds_id: str) -> FluidDataStoreRuntime:
+        return FluidDataStoreRuntime(
+            ds_id, self.registry, self.client_id,
+            self._make_ds_submit(ds_id),
+            on_channel_create=self._on_channel_create)
+
+    def _make_ds_submit(self, ds_id: str):
+        def submit(inner: dict, metadata: Optional[dict]) -> None:
+            self._submit_runtime_op({"address": ds_id, "contents": inner},
+                                    metadata)
+        return submit
+
+    # ---------------------------------------------------------------- inbound
+
+    def process(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        """The processOp loop (§3.2): expand one wire message and route."""
+        self.last_seq = msg.seq
+        if msg.type != MessageType.OP:
+            self._emit("op", msg, local)
+            return
+        for runtime_msg in self.inbound.process(msg):
+            if local:
+                record = self.pending.process_local(runtime_msg)
+                if record["metadata"] is not None \
+                        and runtime_msg.metadata is None:
+                    runtime_msg = dataclasses.replace(
+                        runtime_msg, metadata=record["metadata"])
+            self._route(runtime_msg, local)
+            self._emit("runtimeOp", runtime_msg, local)
+        if msg.min_seq > self.min_seq:
+            self.min_seq = msg.min_seq
+            for ds in self.datastores.values():
+                ds.on_min_seq(msg.min_seq)
+        self._emit("op", msg, local)
+
+    def _route(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        contents = msg.contents
+        if not isinstance(contents, dict):
+            return
+        kind = contents.get("type")
+        if kind == ATTACH:
+            if not local and not self.has_data_store(contents["id"]):
+                self._pending_ds_summaries[contents["id"]] = \
+                    contents["summary"]
+            return
+        if kind == ATTACH_CHANNEL:
+            if not local:
+                ds = self.get_data_store(contents["address"])
+                if not ds.has_channel(contents["id"]):
+                    ds._pending_summaries[contents["id"]] = \
+                        contents["summary"]
+            return
+        if kind == ID_RANGE:
+            if self.id_compressor is not None:
+                self.id_compressor.finalize_range(
+                    IdCreationRange(**contents["range"]))
+            return
+        if "address" in contents:
+            self.get_data_store(contents["address"]).process(msg, local)
+
+    # --------------------------------------------------------------- outbound
+
+    def _submit_runtime_op(self, contents: dict,
+                           metadata: Optional[dict] = None) -> None:
+        self.pending.on_submit(contents, metadata)
+        if self.connected:
+            self.outbox.submit(contents, metadata)
+            if self.options.flush_mode == "immediate":
+                self.flush()
+        # while disconnected the record waits in pending; reconnect resubmits
+
+    def flush(self) -> int:
+        """End-of-turn flush (reference: Outbox.flush at JS turn end)."""
+        if not self.connected:
+            return 0
+        if self.id_compressor is not None:
+            rng = self.id_compressor.take_next_creation_range()
+            if rng is not None:
+                # the range rides ahead of the batch ops that use its ids, so
+                # peers can resolve them — but AFTER any earlier (resubmitted)
+                # range already in the outbox: ranges must hit the wire in
+                # generation order or finalize_range rejects them
+                record = {"type": ID_RANGE,
+                          "range": dataclasses.asdict(rng)}
+                ops = self.outbox.main._ops
+                idx = 0
+                for i, op in enumerate(ops):
+                    if isinstance(op["contents"], dict) \
+                            and op["contents"].get("type") == ID_RANGE:
+                        idx = i + 1
+                # pending order mirrors wire order
+                self.pending.insert_before_last(
+                    self.outbox.pending_count - idx, record, None)
+                ops.insert(idx, {"contents": record, "metadata": None})
+        return self.outbox.flush()
+
+    def _send_wire_op(self, contents: dict,
+                      metadata: Optional[dict]) -> None:
+        """Metadata is folded into the wire contents here (the drivers'
+        submit carries contents only); RemoteMessageProcessor unwraps it
+        first on the inbound side."""
+        if metadata is not None:
+            contents = {"type": WITH_METADATA, "contents": contents,
+                        "metadata": metadata}
+        self._wire_submit(contents)
+
+    def generate_document_unique_id(self) -> int:
+        """Reference: ContainerRuntime.generateDocumentUniqueId — a compact
+        id finalized through the op stream (§2.11)."""
+        assert self.id_compressor is not None, "id compressor disabled"
+        return self.id_compressor.generate_id()
+
+    # ------------------------------------------------------------- connection
+
+    def set_connection_state(self, connected: bool,
+                             client_id: Optional[int]) -> None:
+        """Loader container calls this on connect/disconnect (§2.10). On
+        reconnect: adopt the new client id, then resubmit pending records
+        through the channels (rebase hook — §3.3)."""
+        self.connected = connected
+        if not connected:
+            # unflushed outbox entries survive only as pending records
+            self.outbox.main.pop_batch()
+            return
+        assert client_id is not None
+        self.client_id = client_id
+        for ds in self.datastores.values():
+            ds.set_client_id(client_id)
+        # stashed records whose targets only existed past the loaded summary
+        # can apply now: catch-up replayed the op tail before "connected"
+        for record in self._deferred_stash:
+            applied = self._apply_stash_record(record)
+            assert applied, \
+                "stashed op targets state absent from summary and op tail"
+        self._deferred_stash = []
+        for record in self.pending.take_pending():
+            self._resubmit(record)
+        self.flush()
+
+    def _resubmit(self, record: dict) -> None:
+        contents, metadata = record["contents"], record["metadata"]
+        kind = contents.get("type") if isinstance(contents, dict) else None
+        if kind in (ATTACH, ATTACH_CHANNEL, ID_RANGE):
+            self._submit_runtime_op(contents, metadata)
+        elif isinstance(contents, dict) and "address" in contents:
+            self.get_data_store(contents["address"]).resubmit(
+                contents["contents"], metadata)
+        else:
+            self._submit_runtime_op(contents, metadata)
+
+    # ---------------------------------------------------------------- summary
+
+    def summarize(self) -> dict:
+        """Runtime summary subtree (§3.4): every datastore, realized or not,
+        plus document-global id-compressor state."""
+        datastores = {ds_id: ds.summarize()
+                      for ds_id, ds in self.datastores.items()}
+        datastores.update(self._pending_ds_summaries)
+        out = {"datastores": datastores}
+        if self.id_compressor is not None:
+            out["idCompressor"] = self.id_compressor.summarize()
+        return out
+
+    def _load_summary(self, summary: dict) -> None:
+        self._pending_ds_summaries = dict(summary.get("datastores", {}))
+        if self.id_compressor is not None and "idCompressor" in summary:
+            self.id_compressor = IdCompressor.load(summary["idCompressor"])
+
+    # ------------------------------------------------------------ stash state
+
+    def get_pending_local_state(self) -> list:
+        """Stash blob for offline resume (reference: getPendingLocalState)."""
+        return self.pending.serialize()
+
+    def _rehydrate(self, blob: list) -> None:
+        """Re-apply stashed ops as local pending state (reference:
+        applyStashedOp, §5.3): channel ops are re-applied optimistically so
+        the local view includes them, then recorded pending; attach ops
+        re-create their datastores locally. A record that targets a
+        datastore/channel the loaded summary doesn't cover (it was created
+        by ops past the summary) is deferred — the op tail replays during
+        catch-up, and the record's side effects apply on connect, before
+        resubmission."""
+        for record in blob:
+            if not self._apply_stash_record(record):
+                self._deferred_stash.append(record)
+            self.pending.on_submit(record["contents"],
+                                   record.get("metadata"))
+
+    def _apply_stash_record(self, record: dict) -> bool:
+        """Apply one stashed record's local side effects; False if its
+        target doesn't exist yet (retry after catch-up)."""
+        contents = record["contents"]
+        kind = contents.get("type") if isinstance(contents, dict) else None
+        if kind == ATTACH:
+            if not self.has_data_store(contents["id"]):
+                ds = FluidDataStoreRuntime.load(
+                    contents["id"], self.registry, self.client_id,
+                    self._make_ds_submit(contents["id"]),
+                    contents["summary"],
+                    on_channel_create=self._on_channel_create)
+                self.datastores[contents["id"]] = ds
+            return True
+        if kind == ATTACH_CHANNEL:
+            if not self.has_data_store(contents["address"]):
+                return False
+            ds = self.get_data_store(contents["address"])
+            if not ds.has_channel(contents["id"]):
+                ds._pending_summaries[contents["id"]] = contents["summary"]
+            return True
+        if kind == ID_RANGE:
+            return True  # ranges from a dead session are regenerated
+        if isinstance(contents, dict) and "address" in contents:
+            if not self.has_data_store(contents["address"]):
+                return False
+            ds = self.get_data_store(contents["address"])
+            inner = contents["contents"]
+            if not ds.has_channel(inner["address"]):
+                return False
+            ds.get_channel(inner["address"]).apply_stashed_op(
+                inner["contents"])
+            return True
+        return True

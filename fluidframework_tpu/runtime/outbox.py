@@ -1,0 +1,139 @@
+"""Outbox: outbound op batching, compression, grouping, chunking.
+
+Reference counterpart: ``Outbox`` / ``BatchManager`` / ``OpCompressor`` /
+``OpGroupingManager`` / ``OpSplitter`` in ``@fluidframework/container-runtime``
+(SURVEY.md §2.8, §3.3; mount empty). Pipeline, applied at flush time to the
+ops accumulated during one host "turn":
+
+1. **batching** — ops submitted between flushes form one atomic batch; batch
+   boundaries are marked in metadata (``batch: True`` on the first op,
+   ``batch: False`` on the last) so receivers can apply them atomically;
+2. **grouped batching** — a multi-op batch is wrapped into ONE envelope op
+   (type ``groupedBatch``) so the ordering service stamps a single sequence
+   number and per-op sub-sequencing is reconstructed client-side;
+3. **compression** — serialized batch payloads over a size threshold are
+   zlib-compressed (base64 text payload, original op carried as dark matter);
+4. **chunking** — a compressed payload over the max-op-size is split across
+   multiple ``chunkedOp`` ops, reassembled before decompression.
+
+The inverse lives in ``remote_message_processor.py``. TPU-first note: grouped
+batching is what makes the device path efficient — one sequenced envelope
+yields a dense (op × fields) slab that packs straight into the int32 op
+planes of ``ops.schema`` without per-op host dispatch.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+# envelope op types (carried inside MessageType.OP contents)
+GROUPED_BATCH = "groupedBatch"
+COMPRESSED = "compressed"
+CHUNKED = "chunkedOp"
+
+
+class BatchManager:
+    """Accumulates the current batch (reference: BatchManager)."""
+
+    def __init__(self):
+        self._ops: List[dict] = []
+
+    def push(self, contents: dict, metadata: Optional[dict] = None) -> None:
+        self._ops.append({"contents": contents, "metadata": metadata})
+
+    @property
+    def empty(self) -> bool:
+        return not self._ops
+
+    def __len__(self) -> int:
+        return len(self._ops)
+
+    def pop_batch(self) -> List[dict]:
+        ops, self._ops = self._ops, []
+        if len(ops) > 1:
+            # batch-boundary metadata (reference: batchMetadata flag)
+            ops[0] = {**ops[0], "metadata": {**(ops[0]["metadata"] or {}),
+                                             "batch": True}}
+            ops[-1] = {**ops[-1], "metadata": {**(ops[-1]["metadata"] or {}),
+                                               "batch": False}}
+        return ops
+
+
+class Outbox:
+    """Flush-time pipeline: group → compress → chunk → submit.
+
+    ``submit_fn(contents, metadata)`` sends ONE wire op; the outbox calls it
+    once per flushed envelope (or once per op when grouping is off and the
+    batch is a singleton).
+    """
+
+    def __init__(self, submit_fn: Callable[[dict, Optional[dict]], None],
+                 grouped_batching: bool = True,
+                 compression_threshold: int = 4096,
+                 max_op_size: int = 16384):
+        self._submit = submit_fn
+        self.grouped_batching = grouped_batching
+        self.compression_threshold = compression_threshold
+        self.max_op_size = max_op_size
+        self.main = BatchManager()
+        self._chunk_id = 0
+
+    # ------------------------------------------------------------- enqueueing
+
+    def submit(self, contents: dict, metadata: Optional[dict] = None) -> None:
+        self.main.push(contents, metadata)
+
+    @property
+    def pending_count(self) -> int:
+        return len(self.main)
+
+    # ------------------------------------------------------------------ flush
+
+    def flush(self) -> int:
+        """Send the accumulated batch; returns number of wire ops sent."""
+        if self.main.empty:
+            return 0
+        batch = self.main.pop_batch()
+        if self.grouped_batching and len(batch) > 1:
+            envelope = {"type": GROUPED_BATCH,
+                        "contents": [{"contents": op["contents"],
+                                      "metadata": op["metadata"]}
+                                     for op in batch]}
+            return self._send_maybe_compressed(envelope, None)
+        sent = 0
+        for op in batch:
+            sent += self._send_maybe_compressed(op["contents"],
+                                                op["metadata"])
+        return sent
+
+    def _send_maybe_compressed(self, contents: dict,
+                               metadata: Optional[dict]) -> int:
+        raw = json.dumps(contents, separators=(",", ":"))
+        if len(raw) < self.compression_threshold \
+                and len(raw) <= self.max_op_size:
+            self._submit(contents, metadata)
+            return 1
+        packed = base64.b64encode(zlib.compress(raw.encode())).decode()
+        envelope = {"type": COMPRESSED, "payload": packed}
+        if len(packed) <= self.max_op_size:
+            self._submit(envelope, metadata)
+            return 1
+        return self._send_chunked(packed, metadata)
+
+    def _send_chunked(self, payload: str, metadata: Optional[dict]) -> int:
+        """Split an oversized compressed payload into chunkedOp pieces
+        (reference: OpSplitter). Only the LAST chunk carries the original
+        metadata — it is the op that "happens"; earlier chunks are inert
+        carriers reassembled by the receiver."""
+        self._chunk_id += 1
+        n = (len(payload) + self.max_op_size - 1) // self.max_op_size
+        for i in range(n):
+            piece = payload[i * self.max_op_size:(i + 1) * self.max_op_size]
+            self._submit({"type": CHUNKED, "chunkId": self._chunk_id,
+                          "chunkIndex": i, "totalChunks": n,
+                          "payload": piece},
+                         metadata if i == n - 1 else None)
+        return n

@@ -1,0 +1,406 @@
+"""Container runtime layer (L3): outbox pipeline, remote message processor,
+id compressor, datastore routing, pending state / reconnect / stash.
+Reference behaviors per SURVEY.md §2.8/§2.9/§2.11, §3.2–3.3, §5.3."""
+
+import dataclasses
+
+import pytest
+
+from fluidframework_tpu.core.protocol import (
+    MessageType, SequencedDocumentMessage,
+)
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container, Loader
+from fluidframework_tpu.runtime import (
+    ContainerRuntime, ContainerRuntimeOptions, IdCompressor, IdCreationRange,
+    Outbox, RemoteMessageProcessor, stable_id,
+)
+from fluidframework_tpu.server.tinylicious import LocalService
+
+
+def wire_msg(seq, contents, client_id=1, type=MessageType.OP, metadata=None):
+    return SequencedDocumentMessage(
+        doc_id="d", client_id=client_id, client_seq=seq, ref_seq=0,
+        seq=seq, min_seq=0, type=type, contents=contents, metadata=metadata)
+
+
+# ------------------------------------------------------------- IdCompressor
+
+class TestIdCompressor:
+    def test_local_ids_are_negative_and_monotone(self):
+        c = IdCompressor()
+        assert [c.generate_id() for _ in range(3)] == [-1, -2, -3]
+
+    def test_creation_range_covers_unannounced_tail(self):
+        c = IdCompressor()
+        c.generate_id(), c.generate_id()
+        rng = c.take_next_creation_range()
+        assert (rng.first_gen_count, rng.count) == (1, 2)
+        assert c.take_next_creation_range() is None
+        c.generate_id()
+        rng2 = c.take_next_creation_range()
+        assert (rng2.first_gen_count, rng2.count) == (3, 1)
+
+    def test_two_sessions_converge_on_final_ids(self):
+        a, b = IdCompressor(cluster_capacity=4), IdCompressor(cluster_capacity=4)
+        a.generate_id(); a.generate_id()
+        b.generate_id()
+        ra = a.take_next_creation_range()
+        rb = b.take_next_creation_range()
+        for comp in (a, b):              # same total order on both replicas
+            comp.finalize_range(ra)
+            comp.finalize_range(rb)
+        # a's ids finalized first → finals 0,1; b's id starts a new cluster
+        assert a.normalize_to_op_space(-1) == 0
+        assert a.normalize_to_op_space(-2) == 1
+        assert b.normalize_to_op_space(-1) == 4  # after a's capacity-4 cluster
+        # cross-session resolution
+        assert b.normalize_to_session_space(-2, originator=a.session_id) == 1
+        assert a.decompress(-1) == b.decompress(0) == stable_id(a.session_id, 1)
+
+    def test_cluster_slack_keeps_session_contiguous(self):
+        a, b = IdCompressor(cluster_capacity=8), IdCompressor(cluster_capacity=8)
+        a.generate_id()
+        r1 = a.take_next_creation_range()
+        a.finalize_range(r1); b.finalize_range(r1)
+        a.generate_id(); a.generate_id()
+        r2 = a.take_next_creation_range()
+        a.finalize_range(r2); b.finalize_range(r2)
+        # second range fills the same cluster: finals stay contiguous
+        assert [a.normalize_to_op_space(i) for i in (-1, -2, -3)] == [0, 1, 2]
+
+    def test_out_of_order_range_rejected(self):
+        a = IdCompressor()
+        a.generate_id()
+        with pytest.raises(ValueError):
+            a.finalize_range(IdCreationRange(a.session_id,
+                                             first_gen_count=5, count=1))
+
+    def test_summary_roundtrip(self):
+        a = IdCompressor(cluster_capacity=4)
+        a.generate_id()
+        rng = a.take_next_creation_range()
+        a.finalize_range(rng)
+        fresh = IdCompressor.load(a.summarize())
+        assert fresh.normalize_to_session_space(0) == 0
+        assert fresh.decompress(0) == stable_id(a.session_id, 1)
+        # new clusters on the loaded replica allocate past the loaded ones
+        fresh.generate_id()
+        r2 = fresh.take_next_creation_range()
+        fresh.finalize_range(r2)
+        assert fresh.normalize_to_op_space(-1) == 4
+
+
+# ------------------------------------------- outbox → remote processor loop
+
+def roundtrip(outbox_kwargs, ops):
+    """Push ops through an Outbox, replay the wire ops through a
+    RemoteMessageProcessor, return the expanded runtime messages."""
+    wire = []
+    ob = Outbox(lambda c, m: wire.append(c), **outbox_kwargs)
+    for op in ops:
+        ob.submit(op)
+    ob.flush()
+    rmp = RemoteMessageProcessor()
+    out = []
+    for i, contents in enumerate(wire):
+        out.extend(rmp.process(wire_msg(i + 1, contents)))
+    return wire, out
+
+
+class TestOutboxPipeline:
+    def test_grouped_batch_is_one_wire_op(self):
+        ops = [{"op": "set", "key": f"k{i}", "value": i} for i in range(5)]
+        wire, out = roundtrip(dict(grouped_batching=True), ops)
+        assert len(wire) == 1
+        assert [m.contents for m in out] == ops
+        assert all(m.seq == 1 for m in out)  # shared envelope seq
+
+    def test_ungrouped_batch_carries_boundary_metadata(self):
+        ops = [{"i": 0}, {"i": 1}, {"i": 2}]
+        wire = []
+        ob = Outbox(lambda c, m: wire.append((c, m)), grouped_batching=False)
+        for op in ops:
+            ob.submit(op)
+        ob.flush()
+        assert len(wire) == 3
+        assert wire[0][1] == {"batch": True}
+        assert wire[-1][1] == {"batch": False}
+
+    def test_compression_roundtrip(self):
+        big = {"op": "set", "key": "k", "value": "x" * 9000}
+        wire, out = roundtrip(
+            dict(compression_threshold=256, max_op_size=1 << 20), [big])
+        assert len(wire) == 1 and wire[0]["type"] == "compressed"
+        assert [m.contents for m in out] == [big]
+
+    def test_chunking_roundtrip(self):
+        import base64
+        import hashlib
+        # incompressible payload so the compressed form still overflows
+        # max_op_size and must chunk
+        chunks = [hashlib.sha256(str(i).encode()).digest()
+                  for i in range(300)]
+        big = {"op": "set", "key": "k",
+               "value": base64.b64encode(b"".join(chunks)).decode()}
+        wire, out = roundtrip(
+            dict(compression_threshold=256, max_op_size=512), [big])
+        assert len(wire) > 1
+        assert all(c["type"] == "chunkedOp" for c in wire)
+        assert [m.contents for m in out] == [big]
+
+    def test_grouped_compressed_batch(self):
+        ops = [{"k": i, "pad": "y" * 600} for i in range(10)]
+        wire, out = roundtrip(
+            dict(grouped_batching=True, compression_threshold=1024,
+                 max_op_size=1 << 20), ops)
+        assert len(wire) == 1 and wire[0]["type"] == "compressed"
+        assert [m.contents for m in out] == ops
+
+    def test_empty_flush_sends_nothing(self):
+        wire = []
+        ob = Outbox(lambda c, m: wire.append(c))
+        assert ob.flush() == 0 and wire == []
+
+
+# ---------------------------------------------------- end-to-end containers
+
+def make_pair(options=None, service=None):
+    svc = service or LocalService()
+    loader = Loader(LocalDocumentServiceFactory(svc),
+                    ContainerRuntime.factory(options=options))
+    a = loader.resolve("doc")
+    b = loader.resolve("doc")
+    return svc, loader, a, b
+
+
+class TestRuntimeEndToEnd:
+    def test_map_converges_across_containers(self):
+        _, _, a, b = make_pair()
+        ds_a = a.runtime.create_data_store("default")
+        m_a = ds_a.create_channel("root", "map")
+        m_a.set("title", "hello")
+        m_a.set("n", 42)
+        m_b = b.runtime.get_data_store("default").get_channel("root")
+        assert m_b.get("title") == "hello" and m_b.get("n") == 42
+        m_b.set("n", 43)
+        assert m_a.get("n") == 43
+
+    def test_turn_mode_groups_batch_into_one_sequenced_op(self):
+        opts = ContainerRuntimeOptions(flush_mode="turn",
+                                       grouped_batching=True)
+        svc, _, a, b = make_pair(opts)
+        ds = a.runtime.create_data_store("default")
+        m = ds.create_channel("root", "map")
+        a.runtime.flush()
+        seq_before = a.delta_manager.last_sequence_number
+        for i in range(10):
+            m.set(f"k{i}", i)
+        assert a.delta_manager.last_sequence_number == seq_before
+        a.runtime.flush()
+        # one grouped envelope = one sequence number for all 10 ops
+        assert a.delta_manager.last_sequence_number == seq_before + 1
+        m_b = b.runtime.get_data_store("default").get_channel("root")
+        assert all(m_b.get(f"k{i}") == i for i in range(10))
+
+    def test_compressed_chunked_ops_converge(self):
+        opts = ContainerRuntimeOptions(compression_threshold=128,
+                                       max_op_size=256)
+        _, _, a, b = make_pair(opts)
+        ds = a.runtime.create_data_store("default")
+        m = ds.create_channel("root", "map")
+        m.set("blob", "q" * 5000)
+        m_b = b.runtime.get_data_store("default").get_channel("root")
+        assert m_b.get("blob") == "q" * 5000
+
+    def test_multiple_datastores_and_channels_route_independently(self):
+        _, _, a, b = make_pair()
+        d1 = a.runtime.create_data_store("d1")
+        d2 = a.runtime.create_data_store("d2")
+        d1.create_channel("m", "map").set("x", 1)
+        d2.create_channel("m", "map").set("x", 2)
+        d2.create_channel("c", "counter").increment(5)
+        assert b.runtime.get_data_store("d1").get_channel("m").get("x") == 1
+        assert b.runtime.get_data_store("d2").get_channel("m").get("x") == 2
+        assert b.runtime.get_data_store("d2").get_channel("c").value == 5
+
+    def test_late_joiner_realizes_from_attach_ops(self):
+        svc = LocalService()
+        loader = Loader(LocalDocumentServiceFactory(svc),
+                        ContainerRuntime.factory())
+        a = loader.resolve("doc")
+        m = a.runtime.create_data_store("default").create_channel("r", "map")
+        m.set("k", "v")
+        late = loader.resolve("doc")
+        assert late.runtime.get_data_store("default") \
+                   .get_channel("r").get("k") == "v"
+
+    def test_id_compressor_rides_op_stream(self):
+        _, _, a, b = make_pair()
+        a.runtime.create_data_store("default").create_channel("r", "map")
+        local = a.runtime.generate_document_unique_id()
+        assert local == -1
+        # any flush ships the pending creation range
+        a.runtime.get_data_store("default").get_channel("r").set("x", 1)
+        final = a.runtime.id_compressor.normalize_to_op_space(local)
+        assert final >= 0
+        # replica b finalized the same range at the same sequence point
+        assert b.runtime.id_compressor.normalize_to_session_space(
+            final) == final
+        assert b.runtime.id_compressor.decompress(final) == \
+            a.runtime.id_compressor.decompress(local)
+
+    def test_shared_string_via_runtime(self):
+        _, _, a, b = make_pair()
+        ds = a.runtime.create_data_store("default")
+        s = ds.create_channel("text", "sharedString")
+        s.insert_text(0, "hello world")
+        s_b = b.runtime.get_data_store("default").get_channel("text")
+        s_b.insert_text(5, ",")
+        assert s.get_text() == s_b.get_text() == "hello, world"
+
+
+# ------------------------------------------------- reconnect + stash resume
+
+class TestPendingAndReconnect:
+    def test_ops_while_disconnected_resubmit_on_reconnect(self):
+        _, _, a, b = make_pair()
+        m = a.runtime.create_data_store("default").create_channel("r", "map")
+        m.set("before", 1)
+        a.disconnect("test")
+        m.set("offline", 2)          # recorded pending, not sent
+        m_b = b.runtime.get_data_store("default").get_channel("r")
+        assert m_b.get("offline") is None
+        a.connect()                  # resubmits through the channels
+        assert m_b.get("offline") == 2
+        assert not a.runtime.pending.has_pending
+
+    def test_remote_edits_during_offline_merge_lww(self):
+        _, _, a, b = make_pair()
+        m_a = a.runtime.create_data_store("default").create_channel("r", "map")
+        m_b = b.runtime.get_data_store("default").get_channel("r")
+        a.disconnect("net")
+        m_a.set("k", "from-a")       # pending offline
+        m_b.set("k", "from-b")       # sequenced now
+        a.connect()                  # a's op sequenced after b's → a wins
+        assert m_a.get("k") == "from-a" and m_b.get("k") == "from-a"
+
+    def test_stash_and_rehydrate_resumes_pending_ops(self):
+        svc = LocalService()
+        loader = Loader(LocalDocumentServiceFactory(svc),
+                        ContainerRuntime.factory())
+        a = loader.resolve("doc")
+        m = a.runtime.create_data_store("default").create_channel("r", "map")
+        m.set("committed", 1)
+        # summary covering the committed state (rehydrate loads from it)
+        summary = {"protocol": a.protocol.snapshot(),
+                   "runtime": a.runtime.summarize()}
+        svc.upload_summary("doc", summary, a.protocol.seq)
+        a.disconnect("going offline")
+        m.set("stashed", 2)
+        blob = a.runtime.get_pending_local_state()
+        a.close()
+
+        resumed = Loader(
+            LocalDocumentServiceFactory(svc),
+            ContainerRuntime.factory(pending_blob=blob)).resolve("doc")
+        m2 = resumed.runtime.get_data_store("default").get_channel("r")
+        assert m2.get("committed") == 1 and m2.get("stashed") == 2
+        b = loader.resolve("doc")
+        assert b.runtime.get_data_store("default").get_channel("r") \
+                .get("stashed") == 2
+
+    def test_summary_roundtrip_through_runtime(self):
+        svc = LocalService()
+        loader = Loader(LocalDocumentServiceFactory(svc),
+                        ContainerRuntime.factory())
+        a = loader.resolve("doc")
+        ds = a.runtime.create_data_store("default")
+        ds.create_channel("m", "map").set("k", "v")
+        ds.create_channel("s", "sharedString").insert_text(0, "abc")
+        summary_seq = a.protocol.seq
+        summary = {"protocol": a.protocol.snapshot(),
+                   "runtime": a.runtime.summarize()}
+        svc.upload_summary("doc", summary, summary_seq)
+        fresh = loader.resolve("doc")
+        assert fresh.base_seq == summary_seq  # loaded summary, not replay
+        fds = fresh.runtime.get_data_store("default")
+        assert fds.get_channel("m").get("k") == "v"
+        assert fds.get_channel("s").get_text() == "abc"
+        # post-summary collaboration still flows
+        fds.get_channel("m").set("k2", 2)
+        assert ds.get_channel("m").get("k2") == 2
+
+
+# ----------------------------------------- review-finding regression tests
+
+class TestReviewRegressions:
+    def test_small_threshold_large_op_still_respects_max_size(self):
+        # op under compression_threshold but over max_op_size must not ship
+        # as one oversized wire op
+        wire, out = roundtrip(
+            dict(compression_threshold=1 << 20, max_op_size=64),
+            [{"op": "set", "key": "k", "value": "v" * 500}])
+        assert all(
+            len(__import__("json").dumps(c, separators=(",", ":"))) <= 3 * 64
+            for c in wire)  # chunk pieces bounded (payload + small envelope)
+        assert out[0].contents["value"] == "v" * 500
+
+    def test_batch_metadata_travels_over_the_wire(self):
+        opts = ContainerRuntimeOptions(flush_mode="turn",
+                                       grouped_batching=False)
+        _, _, a, b = make_pair(opts)
+        seen = []
+        b.runtime.on("runtimeOp",
+                     lambda msg, local: seen.append(msg.metadata))
+        m = a.runtime.create_data_store("default").create_channel("r", "map")
+        a.runtime.flush()
+        seen.clear()
+        m.set("x", 1)
+        m.set("y", 2)
+        m.set("z", 3)
+        a.runtime.flush()
+        # first wire op of the batch marked batch=True, last batch=False
+        metas = [meta for meta in seen if meta is not None]
+        assert {"batch": True} in metas and {"batch": False} in metas
+
+    def test_stash_with_post_summary_datastore_defers_until_catchup(self):
+        svc = LocalService()
+        loader = Loader(LocalDocumentServiceFactory(svc),
+                        ContainerRuntime.factory())
+        a = loader.resolve("doc")
+        # summary BEFORE the datastore exists
+        svc.upload_summary("doc", {"protocol": a.protocol.snapshot(),
+                                   "runtime": a.runtime.summarize()},
+                           a.protocol.seq)
+        m = a.runtime.create_data_store("late").create_channel("r", "map")
+        m.set("committed", 1)
+        a.disconnect("offline")
+        m.set("stashed", 2)
+        blob = a.runtime.get_pending_local_state()
+        a.close()
+        # rehydrate: summary has no 'late' datastore; the attach op is in
+        # the op tail, so the stashed record must defer, then apply
+        resumed = Loader(
+            LocalDocumentServiceFactory(svc),
+            ContainerRuntime.factory(pending_blob=blob)).resolve("doc")
+        m2 = resumed.runtime.get_data_store("late").get_channel("r")
+        assert m2.get("committed") == 1 and m2.get("stashed") == 2
+
+    def test_reconnect_id_ranges_stay_in_generation_order(self):
+        _, _, a, b = make_pair()
+        a.runtime.create_data_store("default").create_channel("r", "map")
+        a.disconnect("net")
+        # range R1 generated+pending while offline
+        i1 = a.runtime.generate_document_unique_id()
+        ds = a.runtime.get_data_store("default")
+        ds.get_channel("r").set("k", 1)
+        a.connect()
+        # on reconnect a second id: its range must finalize after R1's
+        i2 = a.runtime.generate_document_unique_id()
+        ds.get_channel("r").set("k2", 2)
+        f1 = a.runtime.id_compressor.normalize_to_op_space(i1)
+        f2 = a.runtime.id_compressor.normalize_to_op_space(i2)
+        assert 0 <= f1 < f2
+        assert b.runtime.id_compressor.decompress(f1) == \
+            a.runtime.id_compressor.decompress(i1)
