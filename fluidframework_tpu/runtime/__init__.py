@@ -7,20 +7,28 @@ from .container_runtime import (
     DEFAULT_DATASTORE,
 )
 from .datastore import FluidDataStoreRuntime
+from .gc import GarbageCollector, collect_handles, fluid_handle, is_handle
 from .id_compressor import IdCompressor, IdCreationRange, stable_id
 from .outbox import Outbox
 from .pending_state import PendingStateManager
 from .remote_message_processor import RemoteMessageProcessor
+from .summarizer import SummaryConfig, SummaryManager
 
 __all__ = [
     "ContainerRuntime",
     "ContainerRuntimeOptions",
     "DEFAULT_DATASTORE",
     "FluidDataStoreRuntime",
+    "GarbageCollector",
+    "collect_handles",
+    "fluid_handle",
+    "is_handle",
     "IdCompressor",
     "IdCreationRange",
     "stable_id",
     "Outbox",
     "PendingStateManager",
     "RemoteMessageProcessor",
+    "SummaryConfig",
+    "SummaryManager",
 ]

@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..models.shared_object import ChannelRegistry, default_registry
 from .datastore import FluidDataStoreRuntime
+from .gc import GarbageCollector
 from .id_compressor import IdCompressor, IdCreationRange
 from .outbox import Outbox
 from .pending_state import PendingStateManager
@@ -60,6 +61,8 @@ class ContainerRuntimeOptions:
     compression_threshold: int = 4096
     max_op_size: int = 16384
     enable_id_compressor: bool = True
+    enable_gc: bool = True
+    gc_sweep_grace_summaries: int = 2
 
 
 class ContainerRuntime:
@@ -77,6 +80,10 @@ class ContainerRuntime:
         self.datastores: Dict[str, FluidDataStoreRuntime] = {}
         self._pending_ds_summaries: Dict[str, dict] = {}
         self._deferred_stash: List[dict] = []
+        self.root_datastores: set = set()
+        self.gc = GarbageCollector(
+            sweep_grace_summaries=self.options.gc_sweep_grace_summaries,
+            enabled=self.options.enable_gc)
         self.pending = PendingStateManager()
         self.inbound = RemoteMessageProcessor()
         self.id_compressor = IdCompressor() \
@@ -125,17 +132,22 @@ class ContainerRuntime:
 
     # ------------------------------------------------------------- datastores
 
-    def create_data_store(self, ds_id: str = DEFAULT_DATASTORE
-                          ) -> FluidDataStoreRuntime:
+    def create_data_store(self, ds_id: str = DEFAULT_DATASTORE,
+                          root: bool = True) -> FluidDataStoreRuntime:
         """Create + attach a datastore (announced via an attach op so every
-        replica instantiates it — reference: createDataStore + attach)."""
+        replica instantiates it — reference: createDataStore + attach).
+        ``root=True`` makes it a GC root (reference: aliased/root
+        datastores); a non-root datastore survives GC only while some root
+        datastore holds a ``fluid_handle`` to it."""
         assert ds_id not in self.datastores \
             and ds_id not in self._pending_ds_summaries, \
             f"datastore {ds_id!r} already exists"
         ds = self._instantiate(ds_id)
         self.datastores[ds_id] = ds
+        if root:
+            self.root_datastores.add(ds_id)
         self._submit_runtime_op({"type": ATTACH, "id": ds_id,
-                                 "summary": ds.summarize()})
+                                 "root": root, "summary": ds.summarize()})
         return ds
 
     def get_data_store(self, ds_id: str = DEFAULT_DATASTORE
@@ -198,6 +210,8 @@ class ContainerRuntime:
             return
         kind = contents.get("type")
         if kind == ATTACH:
+            if contents.get("root"):
+                self.root_datastores.add(contents["id"])
             if not local and not self.has_data_store(contents["id"]):
                 self._pending_ds_summaries[contents["id"]] = \
                     contents["summary"]
@@ -309,19 +323,35 @@ class ContainerRuntime:
 
     # ---------------------------------------------------------------- summary
 
-    def summarize(self) -> dict:
+    def summarize(self, run_gc: bool = True) -> dict:
         """Runtime summary subtree (§3.4): every datastore, realized or not,
-        plus document-global id-compressor state."""
+        plus document-global id-compressor and GC state. With ``run_gc``,
+        the mark/sweep pass prunes swept datastores from the summary AND
+        from this replica (other replicas drop them when they next load —
+        the GC-op coordination of the reference is collapsed into the
+        summary itself)."""
         datastores = {ds_id: ds.summarize()
                       for ds_id, ds in self.datastores.items()}
         datastores.update(self._pending_ds_summaries)
-        out = {"datastores": datastores}
+        if run_gc and self.gc.enabled:
+            swept_before = len(self.gc.swept)
+            datastores = self.gc.run(datastores, set(self.root_datastores))
+            for ds_id in self.gc.swept[swept_before:]:
+                self.datastores.pop(ds_id, None)
+                self._pending_ds_summaries.pop(ds_id, None)
+        out = {"datastores": datastores,
+               "roots": sorted(self.root_datastores)}
+        if self.gc.enabled:
+            out["gc"] = self.gc.summarize()
         if self.id_compressor is not None:
             out["idCompressor"] = self.id_compressor.summarize()
         return out
 
     def _load_summary(self, summary: dict) -> None:
         self._pending_ds_summaries = dict(summary.get("datastores", {}))
+        self.root_datastores = set(summary.get("roots", ()))
+        if "gc" in summary:
+            self.gc.load(summary["gc"])
         if self.id_compressor is not None and "idCompressor" in summary:
             self.id_compressor = IdCompressor.load(summary["idCompressor"])
 

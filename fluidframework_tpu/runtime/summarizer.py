@@ -1,0 +1,168 @@
+"""Summarizer: election, heuristics, summarize → upload → ack protocol.
+
+Reference counterpart: ``SummaryManager`` + ``OrderedClientElection`` +
+``RunningSummarizer`` / ``Summarizer`` in ``@fluidframework/container-runtime``
+(SURVEY.md §2.8, §3.4; mount empty). Flow preserved from the reference:
+
+1. **Election**: the oldest connected interactive client (first in quorum
+   join order) is the summarizer-elect; every client computes the same
+   election from the same quorum, no extra coordination ops needed.
+2. **Heuristics**: the elected client summarizes when enough ops have
+   accumulated since the last acked summary (``max_ops``) or enough time has
+   passed (``max_time_s``, injected clock), with a minimum op floor so idle
+   documents don't churn.
+3. **Protocol**: build the full summary tree (protocol snapshot + runtime
+   subtree) → upload to summary storage → submit a SUMMARIZE op carrying the
+   storage handle → the service's Scribe validates and sequences a
+   SUMMARY_ACK (or NACK) → on ack, the collaboration window trims (new
+   clients load the summary and replay only the tail — §3.1).
+
+The reference spawns a hidden non-interactive summarizer container; in this
+host-driven design the elected client's manager summarizes in-process — the
+same single-writer guarantee comes from election + Scribe's monotone
+last-summary check.
+
+TPU-first note: ``ContainerRuntime.summarize`` gathers device-resident DDS
+state (e.g. compacted merge-tree segment arrays at the MSN) — the snapshot
+IS the device→host gather, reusing the same kernels as catch-up (north
+star; SURVEY.md §7.7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+
+
+@dataclasses.dataclass
+class SummaryConfig:
+    """Reference: ISummaryConfiguration (§5.6)."""
+
+    max_ops: int = 100            # ops since last ack that force a summary
+    min_ops: int = 1              # never summarize with fewer new ops
+    max_time_s: float = 60.0      # time since last ack that forces a summary
+    max_attempts: int = 3         # consecutive nacks before giving up
+
+
+class SummaryManager:
+    """Per-container summarization agent. Wire one to a loaded container:
+    ``SummaryManager(container)``; it listens to the op stream, and on the
+    elected client runs the summarize protocol automatically. Works with
+    both the synchronous local driver (echo + ack are processed reentrantly
+    inside ``submit``) and an async stream (they arrive later)."""
+
+    def __init__(self, container,
+                 config: Optional[SummaryConfig] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.container = container
+        self.config = config or SummaryConfig()
+        self.clock = clock or time.monotonic
+        self.last_ack_seq = container.base_seq
+        self.last_ack_time = self.clock()
+        self._in_flight = False
+        self.pending_proposal: Optional[int] = None  # seq of our SUMMARIZE op
+        self.failed_attempts = 0
+        self.summaries_acked = 0
+        self.summaries_nacked = 0
+        container.on("op", self._on_op)
+        # a proposal in flight when the connection drops is lost (the op
+        # never sequences for a dead client) — reset so the next elected
+        # window can try again
+        container.on("disconnected", self._on_disconnected)
+
+    def _on_disconnected(self, _reason: str) -> None:
+        self._in_flight = False
+        self.pending_proposal = None
+
+    # --------------------------------------------------------------- election
+
+    @property
+    def elected_client(self) -> Optional[int]:
+        """Oldest quorum member (join order) — reference:
+        OrderedClientElection."""
+        members = self.container.quorum.members
+        return next(iter(members), None)
+
+    @property
+    def is_elected(self) -> bool:
+        cid = self.container.client_id
+        return cid is not None and cid == self.elected_client
+
+    # -------------------------------------------------------------- op stream
+
+    def _on_op(self, msg: SequencedDocumentMessage) -> None:
+        if msg.type == MessageType.SUMMARIZE:
+            if self._in_flight and msg.is_from(self.container.client_id) \
+                    and self.pending_proposal is None:
+                self.pending_proposal = msg.seq
+            return
+        if msg.type == MessageType.SUMMARY_ACK:
+            self.last_ack_seq = msg.contents["summaryProposal"]
+            self.last_ack_time = self.clock()
+            if self._in_flight \
+                    and msg.contents["summaryProposal"] == \
+                    self.pending_proposal:
+                self._in_flight = False
+                self.pending_proposal = None
+                self.failed_attempts = 0
+                self.summaries_acked += 1
+            return
+        if msg.type == MessageType.SUMMARY_NACK:
+            if self._in_flight \
+                    and msg.contents.get("summaryProposal") == \
+                    self.pending_proposal:
+                self._in_flight = False
+                self.pending_proposal = None
+                self.failed_attempts += 1
+                self.summaries_nacked += 1
+            return
+        self.maybe_summarize()
+
+    # ------------------------------------------------------------- heuristics
+
+    def should_summarize(self) -> bool:
+        """RunningSummarizer heuristics (§3.4)."""
+        if not self.is_elected or not self.container.connected:
+            return False
+        if self._in_flight:
+            return False              # one in-flight proposal at a time
+        if self.failed_attempts >= self.config.max_attempts:
+            return False              # give up until the next ack resets us
+        new_ops = self.container.protocol.seq - self.last_ack_seq
+        if new_ops < self.config.min_ops:
+            return False
+        if new_ops >= self.config.max_ops:
+            return True
+        return (self.clock() - self.last_ack_time) >= self.config.max_time_s
+
+    def maybe_summarize(self) -> bool:
+        if not self.should_summarize():
+            return False
+        self.summarize_now()
+        return True
+
+    # ---------------------------------------------------------------- the act
+
+    def summarize_now(self) -> int:
+        """Run one summarize attempt; returns the summary's base seq.
+        (Callable directly for on-demand summaries — reference:
+        summarizeOnDemand.)"""
+        container = self.container
+        seq = container.protocol.seq
+        summary = {
+            "protocol": container.protocol.snapshot(),
+            "runtime": container.runtime.summarize(),
+        }
+        handle = container.service.summary_storage.upload_summary(
+            summary, seq)
+        # mark in-flight BEFORE submit: the synchronous local pipeline
+        # processes the echo (which records pending_proposal) and the ack
+        # reentrantly inside this call
+        self._in_flight = True
+        self.pending_proposal = None
+        container.submit({"handle": handle, "summarySeq": seq},
+                         MessageType.SUMMARIZE)
+        return seq
