@@ -1,0 +1,6 @@
+"""Tooling (reference: packages/tools — fetch-tool, replay tool; SURVEY.md
+§2.18)."""
+
+from .replay import ReplayStats, fetch_document, replay_document
+
+__all__ = ["ReplayStats", "fetch_document", "replay_document"]

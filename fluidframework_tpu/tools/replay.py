@@ -1,0 +1,96 @@
+"""Replay + fetch tools: record a document, re-run its op stream.
+
+Reference counterpart: ``@fluid-tools/fetch-tool`` (download a document's
+snapshots + ops for debugging) and the replay tool built on replay-driver
+(re-execute a recorded op stream against current code — regression + perf;
+BASELINE config #1, the typing-trace replay, is exactly this) — SURVEY.md
+§2.18, §4 (mount empty).
+
+- ``fetch_document(service, out_dir)``: read every sequenced op (and the
+  latest summary, if any) from any ``DocumentService`` and write the
+  on-disk document format of ``drivers.file_driver``.
+- ``replay_document(dir_path)``: load the recorded document through the
+  file driver into a full loader+runtime stack, replaying the op stream
+  through the same ``processOp`` path as live traffic (§3.2), and report
+  timing. ``to_seq`` replays a prefix; ``runtime_factory`` defaults to the
+  standard ``ContainerRuntime``.
+
+CLI: ``python -m fluidframework_tpu.tools.replay <dir> [--to-seq N]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+from ..drivers.definitions import DocumentService
+from ..drivers.file_driver import FileDocumentService, write_document
+from ..loader.container import Container
+from ..runtime import ContainerRuntime
+
+
+@dataclasses.dataclass
+class ReplayStats:
+    doc_id: str
+    base_seq: int            # seq of the summary the replay started from
+    last_seq: int            # final sequence number reached
+    ops_replayed: int
+    wall_s: float
+
+    @property
+    def ops_per_sec(self) -> float:
+        return self.ops_replayed / self.wall_s if self.wall_s > 0 else 0.0
+
+
+def fetch_document(service: DocumentService, out_dir: str) -> int:
+    """Record a live document to ``out_dir``; returns the op count
+    (reference: fetch-tool)."""
+    ops = service.delta_storage.get_deltas(0)
+    latest = service.summary_storage.get_latest_summary()
+    write_document(out_dir, ops, [latest] if latest is not None else None)
+    return len(ops)
+
+
+def replay_document(dir_path: str, to_seq: Optional[int] = None,
+                    runtime_factory: Optional[Callable] = None,
+                    use_summary: bool = True) -> "tuple[Container, ReplayStats]":
+    """Re-run a recorded op stream against the current code (reference:
+    replay tool). With ``use_summary=False`` the summary is ignored and the
+    entire stream replays from seq 0 (full-history regression mode)."""
+    service = FileDocumentService(dir_path, to_seq=to_seq)
+    if not use_summary:
+        service._summary_storage._summary = None
+    factory = runtime_factory or ContainerRuntime.factory()
+    t0 = time.perf_counter()
+    container = Container.load(service, factory, connect=False)
+    wall = time.perf_counter() - t0
+    last_seq = container.delta_manager.last_sequence_number
+    stats = ReplayStats(
+        doc_id=service.doc_id,
+        base_seq=container.base_seq,
+        last_seq=last_seq,
+        ops_replayed=last_seq - container.base_seq,
+        wall_s=wall,
+    )
+    return container, stats
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(description="replay a recorded document")
+    p.add_argument("dir", help="document directory (ops.jsonl + summaries)")
+    p.add_argument("--to-seq", type=int, default=None)
+    p.add_argument("--no-summary", action="store_true",
+                   help="replay full history, ignore summaries")
+    args = p.parse_args(argv)
+    _, stats = replay_document(args.dir, to_seq=args.to_seq,
+                               use_summary=not args.no_summary)
+    print(f"doc={stats.doc_id} base_seq={stats.base_seq} "
+          f"last_seq={stats.last_seq} ops={stats.ops_replayed} "
+          f"wall_s={stats.wall_s:.3f} ops_per_sec={stats.ops_per_sec:.0f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

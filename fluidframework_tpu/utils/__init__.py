@@ -1,1 +1,30 @@
-"""(populated as the build proceeds)"""
+"""Cross-cutting utilities: telemetry (§2.15/§5.1), config/feature gates
+(§5.6)."""
+
+from .config import ConfigProvider
+from .telemetry import (
+    ERROR,
+    GENERIC,
+    PERFORMANCE,
+    BufferSink,
+    Histogram,
+    MetricsCollector,
+    PerformanceEvent,
+    SampledTelemetry,
+    TelemetryLogger,
+    console_sink,
+)
+
+__all__ = [
+    "ConfigProvider",
+    "ERROR",
+    "GENERIC",
+    "PERFORMANCE",
+    "BufferSink",
+    "Histogram",
+    "MetricsCollector",
+    "PerformanceEvent",
+    "SampledTelemetry",
+    "TelemetryLogger",
+    "console_sink",
+]

@@ -1,0 +1,76 @@
+"""Config provider / feature-gate system.
+
+Reference counterpart: ``IConfigProviderBase`` + the ``Fluid.*`` feature-gate
+keys monitored through ``loggerToMonitoringContext`` (SURVEY.md §5.6; mount
+empty). Layered key→value lookup with typed getters: explicit overrides win
+over environment variables (``FLUID_TPU_<KEY with dots as __>``) win over a
+JSON file, falling back to the caller's default — the "stage-roll a risky
+behavior without a release" escape hatch the reference uses feature gates
+for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ConfigProvider:
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None,
+                 json_path: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 env_prefix: str = "FLUID_TPU_"):
+        self._overrides = dict(overrides or {})
+        self._env = env if env is not None else dict(os.environ)
+        self._env_prefix = env_prefix
+        self._file: Dict[str, Any] = {}
+        if json_path and os.path.exists(json_path):
+            with open(json_path) as f:
+                self._file = json.load(f)
+
+    # ----------------------------------------------------------- raw lookup
+
+    def raw(self, key: str) -> Optional[Any]:
+        if key in self._overrides:
+            return self._overrides[key]
+        env_key = self._env_prefix + key.replace(".", "__")
+        if env_key in self._env:
+            return self._env[env_key]
+        return self._file.get(key)
+
+    def set(self, key: str, value: Any) -> None:
+        """Runtime override (highest precedence)."""
+        self._overrides[key] = value
+
+    # -------------------------------------------------------- typed getters
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.raw(key)
+        if v is None:
+            return default
+        if isinstance(v, bool):
+            return v
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self.raw(key)
+        if v is None:
+            return default
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return default
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self.raw(key)
+        if v is None:
+            return default
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return default
+
+    def get_str(self, key: str, default: str = "") -> str:
+        v = self.raw(key)
+        return default if v is None else str(v)

@@ -1,0 +1,201 @@
+"""Structured telemetry: loggers, performance spans, sampled counters.
+
+Reference counterpart: ``@fluidframework/telemetry-utils`` —
+``ITelemetryLogger``/``createChildLogger``, ``PerformanceEvent.timedExec``,
+``LoggingError`` tagging, ``sampledTelemetry`` — SURVEY.md §2.15, §5.1
+(mount empty). Host-pluggable sink (the reference delivers events to a
+host-provided ``ITelemetryBaseLogger``); span taxonomy mirrors the
+reference's hot paths: ``load`` / ``catchup`` / ``opApply`` / ``summarize``.
+
+TPU-first addition (§5.5): ``MetricsCollector`` — per-step counters and
+latency histograms (ops merged, docs touched, p50/p99 apply latency)
+exported from the host loop, the role Prometheus metrics play server-side
+in the reference.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# event categories (reference: ITelemetryBaseEvent.category)
+GENERIC = "generic"
+PERFORMANCE = "performance"
+ERROR = "error"
+
+Sink = Callable[[dict], None]
+
+
+class TelemetryLogger:
+    """Namespaced structured logger (reference: ITelemetryLoggerExt).
+
+    Events are flat dicts: ``{category, eventName, ...props}``; namespaces
+    chain with ``:`` like the reference's logger namespaces.
+    """
+
+    def __init__(self, sink: Optional[Sink] = None, namespace: str = "",
+                 props: Optional[Dict[str, Any]] = None):
+        self._sink = sink
+        self.namespace = namespace
+        self.props = dict(props or {})
+
+    def child(self, namespace: str,
+              props: Optional[Dict[str, Any]] = None) -> "TelemetryLogger":
+        """Reference: createChildLogger — inherits sink + props."""
+        ns = f"{self.namespace}:{namespace}" if self.namespace else namespace
+        return TelemetryLogger(self._sink, ns, {**self.props, **(props or {})})
+
+    def send(self, category: str, event_name: str, **props) -> None:
+        if self._sink is None:
+            return
+        name = f"{self.namespace}:{event_name}" if self.namespace \
+            else event_name
+        self._sink({"category": category, "eventName": name,
+                    **self.props, **props})
+
+    def send_event(self, event_name: str, **props) -> None:
+        self.send(GENERIC, event_name, **props)
+
+    def send_error(self, event_name: str, error: Optional[Exception] = None,
+                   **props) -> None:
+        if error is not None:
+            props.setdefault("error", repr(error))
+            props.setdefault("errorType", type(error).__name__)
+        self.send(ERROR, event_name, **props)
+
+    def performance_event(self, event_name: str,
+                          **props) -> "PerformanceEvent":
+        return PerformanceEvent(self, event_name, props)
+
+
+class PerformanceEvent:
+    """Timed span (reference: PerformanceEvent.timedExec): emits ``_start``
+    on enter and ``_end`` (with duration_ms) or ``_cancel`` (with the error)
+    on exit. Use as a context manager."""
+
+    def __init__(self, logger: TelemetryLogger, event_name: str,
+                 props: Dict[str, Any],
+                 clock: Callable[[], float] = time.perf_counter):
+        self.logger = logger
+        self.event_name = event_name
+        self.props = props
+        self.clock = clock
+        self._t0: Optional[float] = None
+        self.duration_ms: Optional[float] = None
+
+    def __enter__(self) -> "PerformanceEvent":
+        self._t0 = self.clock()
+        self.logger.send(PERFORMANCE, f"{self.event_name}_start",
+                         **self.props)
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        self.duration_ms = (self.clock() - self._t0) * 1e3
+        if exc is None:
+            self.logger.send(PERFORMANCE, f"{self.event_name}_end",
+                             duration_ms=self.duration_ms, **self.props)
+        else:
+            self.logger.send(ERROR, f"{self.event_name}_cancel",
+                             duration_ms=self.duration_ms, error=repr(exc),
+                             **self.props)
+
+
+class SampledTelemetry:
+    """Emit one aggregated event every ``rate`` records (reference:
+    sampledTelemetry for hot-loop counters)."""
+
+    def __init__(self, logger: TelemetryLogger, event_name: str,
+                 rate: int = 1000):
+        self.logger = logger
+        self.event_name = event_name
+        self.rate = rate
+        self.count = 0
+        self.total = 0.0
+
+    def record(self, value: float = 1.0) -> None:
+        self.count += 1
+        self.total += value
+        if self.count >= self.rate:
+            self.flush()
+
+    def flush(self) -> None:
+        if self.count:
+            self.logger.send(PERFORMANCE, self.event_name,
+                             samples=self.count, total=self.total,
+                             mean=self.total / self.count)
+            self.count = 0
+            self.total = 0.0
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with percentile reads."""
+
+    def __init__(self, buckets_ms: Optional[List[float]] = None):
+        # log-spaced defaults covering 10 µs .. 10 s
+        self.bounds = buckets_ms if buckets_ms is not None else [
+            0.01 * (10 ** (i / 4)) for i in range(25)]
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.n = 0
+
+    def record(self, value_ms: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, value_ms)] += 1
+        self.n += 1
+
+    def percentile(self, p: float) -> float:
+        """Upper bound of the bucket containing the p-th percentile."""
+        if self.n == 0:
+            return 0.0
+        target = p / 100.0 * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return self.bounds[i] if i < len(self.bounds) \
+                    else float("inf")
+        return float("inf")
+
+
+class MetricsCollector:
+    """Host-loop counters + latency histograms (SURVEY.md §5.5): the
+    client-side analog of the reference server's per-lambda Prometheus
+    metrics (op rate, lag, pending ops)."""
+
+    def __init__(self):
+        self.counters: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def inc(self, name: str, by: float = 1.0) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + by
+
+    def observe(self, name: str, value_ms: float) -> None:
+        if name not in self.histograms:
+            self.histograms[name] = Histogram()
+        self.histograms[name].record(value_ms)
+
+    def snapshot(self) -> dict:
+        out: Dict[str, Any] = dict(self.counters)
+        for name, h in self.histograms.items():
+            out[f"{name}_p50_ms"] = h.percentile(50)
+            out[f"{name}_p99_ms"] = h.percentile(99)
+            out[f"{name}_count"] = h.n
+        return out
+
+
+def console_sink(event: dict) -> None:
+    """Debug sink: one line per event."""
+    print(" ".join(f"{k}={v}" for k, v in event.items()))
+
+
+class BufferSink:
+    """Test/inspection sink: collects events in memory."""
+
+    def __init__(self):
+        self.events: List[dict] = []
+
+    def __call__(self, event: dict) -> None:
+        self.events.append(event)
+
+    def named(self, suffix: str) -> List[dict]:
+        return [e for e in self.events
+                if e["eventName"].endswith(suffix)]
