@@ -1,0 +1,96 @@
+"""Collaborative text editor example.
+
+Reference counterpart: ``examples/data-objects/shared-text`` (+ the
+ProseMirror integration that pairs SharedString with IntervalCollection) —
+SURVEY.md §2.19, BASELINE configs #1/#5 (mount empty). The canonical Fluid
+demo: a SharedString document with live co-editing, named comment ranges
+(IntervalCollection over local references, sliding as text changes), title
+metadata, and presence cursors over signals.
+
+Run: ``PYTHONPATH=. python examples/shared_text.py`` — simulates a
+three-author editing session over the in-process service and prints the
+converged document.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from fluidframework_tpu.framework import LocalClient, PresenceManager
+
+SCHEMA = {"initialObjects": {"text": "sharedString", "meta": "map"}}
+
+
+class SharedTextSession:
+    """One author's view of the document."""
+
+    def __init__(self, container):
+        self.container = container
+        self.text = container.initial_objects["text"]
+        self.meta = container.initial_objects["meta"]
+        self.presence = PresenceManager(container.container)
+
+    # editor operations
+    def type_text(self, pos: int, s: str) -> None:
+        self.text.insert_text(pos, s)
+        self.presence.set_presence({"cursor": pos + len(s)})
+
+    def delete(self, start: int, end: int) -> None:
+        self.text.remove_text(start, end)
+        self.presence.set_presence({"cursor": start})
+
+    def comment(self, start: int, end: int, note: str) -> str:
+        """Attach a comment to a range; the range slides with edits."""
+        comments = self.text.get_interval_collection("comments")
+        return comments.add(start, end, {"note": note})
+
+    def comments(self):
+        coll = self.text.get_interval_collection("comments")
+        out = []
+        for iv in coll.find_overlapping(0, self.text.get_length()):
+            start, end = coll.endpoints(iv.interval_id)
+            out.append((start, end, iv.props.get("note")))
+        return out
+
+    def set_title(self, title: str) -> None:
+        self.meta.set("title", title)
+
+
+def main() -> int:
+    client = LocalClient()
+    c1, doc_id = client.create_container(SCHEMA)
+    author1 = SharedTextSession(c1)
+    author1.set_title("Design notes")
+    author1.type_text(0, "Fluid merges concurrent edits.")
+
+    author2 = SharedTextSession(client.get_container(doc_id, SCHEMA))
+    author3 = SharedTextSession(client.get_container(doc_id, SCHEMA))
+
+    # author2 comments on "concurrent edits", author3 prepends a heading —
+    # the comment range must slide right as the heading lands
+    cid = author2.comment(13, 29, "cite the merge-tree paper")
+    author3.type_text(0, "# Notes\n")
+
+    # concurrent typing at both ends
+    author1.type_text(author1.text.get_length(), " All replicas converge.")
+    author2.type_text(8, "INTRO: ")
+
+    texts = {a.text.get_text() for a in (author1, author2, author3)}
+    assert len(texts) == 1, f"replicas diverged: {texts}"
+    final = texts.pop()
+
+    (start, end, note), = author3.comments()
+    commented = final[start:end]
+
+    print(f"doc_id   : {doc_id}")
+    print(f"title    : {author3.meta.get('title')}")
+    print(f"text     : {final!r}")
+    print(f"comment  : {note!r} on {commented!r} [{start}:{end}]")
+    print(f"presence : {sorted(author1.presence.get_presences().values(), key=str)}")
+    assert commented == "concurrent edits", commented
+    print("converged: yes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,6 +35,18 @@ class MessageType(enum.IntEnum):
 
 
 @dataclasses.dataclass
+class SignalMessage:
+    """An ephemeral, non-sequenced broadcast message (reference:
+    ISignalMessage). Signals skip the ordering service's sequencing path:
+    they fan out to currently-connected clients immediately, carry no seq,
+    and are never stored — presence cursors, devtools, ephemeral state."""
+
+    doc_id: str
+    client_id: int
+    contents: Any = None
+
+
+@dataclasses.dataclass
 class DocumentMessage:
     """A client-submitted, not-yet-sequenced op (reference: IDocumentMessage)."""
 

@@ -16,7 +16,9 @@ from __future__ import annotations
 
 from typing import Any, Callable, List, Optional, Tuple
 
-from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..core.protocol import (
+    MessageType, SequencedDocumentMessage, SignalMessage,
+)
 
 
 class DeltaStreamConnection:
@@ -38,6 +40,15 @@ class DeltaStreamConnection:
 
     def on_nack(self, fn: Callable[[Any], None]) -> None:
         """Register a listener for nacks addressed to this client."""
+        raise NotImplementedError
+
+    def submit_signal(self, contents: Any) -> None:
+        """Broadcast an ephemeral signal (reference:
+        IDocumentDeltaConnection.submitSignal): no sequencing, no storage,
+        delivered only to currently-connected clients."""
+        raise NotImplementedError
+
+    def on_signal(self, fn: Callable[[SignalMessage], None]) -> None:
         raise NotImplementedError
 
     def disconnect(self) -> None:

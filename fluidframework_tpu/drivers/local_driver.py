@@ -52,6 +52,12 @@ class LocalDeltaStreamConnection(defs.DeltaStreamConnection):
     def on_nack(self, fn: Callable[[Any], None]) -> None:
         self._nack_listeners.append(fn)
 
+    def submit_signal(self, contents: Any) -> None:
+        self._conn.submit_signal(contents)
+
+    def on_signal(self, fn) -> None:
+        self._conn.on_signal(fn)
+
     def disconnect(self) -> None:
         self._conn.disconnect()
 

@@ -36,6 +36,12 @@ class ReplayDeltaStreamConnection(defs.DeltaStreamConnection):
     def on_op(self, fn: Callable[[SequencedDocumentMessage], None]) -> None:
         self._listeners.append(fn)
 
+    def submit_signal(self, contents: Any) -> None:
+        raise ReadonlyConnectionError("replay driver is read-only")
+
+    def on_signal(self, fn) -> None:
+        pass  # recordings carry no signals (they are never stored)
+
     def on_nack(self, fn: Callable[[Any], None]) -> None:
         pass
 

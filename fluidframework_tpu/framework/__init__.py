@@ -1,0 +1,20 @@
+"""Framework / app-model layer (reference: packages/framework — aqueduct,
+fluid-static, service clients, presence; SURVEY.md §1 L5)."""
+
+from .data_object import (
+    ContainerRuntimeFactoryWithDefaultDataObject,
+    DataObject,
+    DataObjectFactory,
+)
+from .fluid_static import FluidContainer, LocalClient, ServiceClient
+from .presence import PresenceManager
+
+__all__ = [
+    "ContainerRuntimeFactoryWithDefaultDataObject",
+    "DataObject",
+    "DataObjectFactory",
+    "FluidContainer",
+    "LocalClient",
+    "ServiceClient",
+    "PresenceManager",
+]

@@ -1,0 +1,147 @@
+"""DataObject / DataObjectFactory: the app-model building block.
+
+Reference counterpart: ``@fluidframework/aqueduct`` — ``DataObject``,
+``PureDataObject``, ``DataObjectFactory``,
+``ContainerRuntimeFactoryWithDefaultDataStore`` (SURVEY.md §1 L5; mount
+empty). A DataObject wraps one datastore with a root SharedDirectory and a
+lifecycle:
+
+- ``initializing_first_time()`` — runs exactly once ever, on the client
+  that creates the object (build initial channels here);
+- ``initializing_from_existing()`` — runs when loading an object someone
+  else created;
+- ``has_initialized()`` — runs every load, after either of the above.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Type
+
+from ..runtime.container_runtime import ContainerRuntime
+from ..runtime.datastore import FluidDataStoreRuntime
+
+ROOT_CHANNEL = "root"
+
+
+class DataObject:
+    """One collaborative object = one datastore + a root directory."""
+
+    def __init__(self, ds: FluidDataStoreRuntime):
+        self._ds = ds
+        self._root = None
+
+    @property
+    def id(self) -> str:
+        return self._ds.id
+
+    @property
+    def datastore(self) -> FluidDataStoreRuntime:
+        return self._ds
+
+    @property
+    def root(self):
+        """The root SharedDirectory (reference: DataObject.root)."""
+        assert self._root is not None, "DataObject not initialized"
+        return self._root
+
+    # ------------------------------------------------------------- lifecycle
+
+    def initializing_first_time(self) -> None:
+        """Create initial state (runs once ever, on the creating client)."""
+
+    def initializing_from_existing(self) -> None:
+        """Hook for loads of an existing object."""
+
+    def has_initialized(self) -> None:
+        """Runs on every load after initialization."""
+
+    # internal
+    def _init_create(self) -> None:
+        self._root = self._ds.create_channel(ROOT_CHANNEL, "directory")
+        self.initializing_first_time()
+        self.has_initialized()
+
+    def _init_load(self) -> None:
+        self._root = self._ds.get_channel(ROOT_CHANNEL)
+        self.initializing_from_existing()
+        self.has_initialized()
+
+    # ------------------------------------------------------------ conveniences
+
+    def create_channel(self, channel_id: str, type_name: str):
+        return self._ds.create_channel(channel_id, type_name)
+
+    def get_channel(self, channel_id: str):
+        return self._ds.get_channel(channel_id)
+
+
+class DataObjectFactory:
+    """Creates/loads one DataObject type on a container runtime (reference:
+    DataObjectFactory — the IFluidDataStoreFactory of the aqueduct world)."""
+
+    def __init__(self, type_name: str,
+                 cls: Type[DataObject] = DataObject):
+        self.type = type_name
+        self.cls = cls
+
+    def create(self, runtime: ContainerRuntime, ds_id: str,
+               root: bool = True) -> DataObject:
+        ds = runtime.create_data_store(ds_id, root=root)
+        obj = self.cls(ds)
+        obj._init_create()
+        return obj
+
+    def load(self, runtime: ContainerRuntime, ds_id: str) -> DataObject:
+        ds = runtime.get_data_store(ds_id)
+        obj = self.cls(ds)
+        obj._init_load()
+        return obj
+
+
+class ContainerRuntimeFactoryWithDefaultDataObject:
+    """Reference: ContainerRuntimeFactoryWithDefaultDataStore — a runtime
+    factory that guarantees a default DataObject exists and exposes it as
+    the container's entry point. Compose with ``loader.Container.load``:
+
+        factory = ContainerRuntimeFactoryWithDefaultDataObject(
+            DataObjectFactory("my-app", MyAppObject))
+        container = Container.load(service, factory)
+        app = factory.get_default(container.runtime)
+    """
+
+    DEFAULT_ID = "default"
+
+    def __init__(self, object_factory: DataObjectFactory,
+                 registry=None, options=None):
+        self.object_factory = object_factory
+        self._runtime_factory = ContainerRuntime.factory(
+            registry=registry, options=options)
+        self._cache: Dict[int, DataObject] = {}
+
+    def __call__(self, container, runtime_summary: Optional[dict]):
+        runtime = self._runtime_factory(container, runtime_summary)
+        if runtime_summary is None:
+            # brand-new document: the first client to connect creates the
+            # default object; late loaders realize it from attach ops, so
+            # creation is deferred until connected (we know then whether the
+            # attach op already exists in the stream)
+            container.on("connected",
+                         lambda _cid: self._ensure_default(runtime))
+        return runtime
+
+    def _ensure_default(self, runtime: ContainerRuntime) -> None:
+        if not runtime.has_data_store(self.DEFAULT_ID):
+            # the creating client keeps its created instance — it must not
+            # re-run the from-existing lifecycle for an object it built
+            self._cache[id(runtime)] = self.object_factory.create(
+                runtime, self.DEFAULT_ID)
+
+    def get_default(self, runtime: ContainerRuntime) -> DataObject:
+        """The container's entry-point object (reference: the default data
+        store resolved from the container's root request)."""
+        key = id(runtime)
+        if key not in self._cache:
+            self._ensure_default(runtime)
+            self._cache[key] = self.object_factory.load(
+                runtime, self.DEFAULT_ID)
+        return self._cache[key]

@@ -1,0 +1,81 @@
+"""Presence: ephemeral per-client state over signals.
+
+Reference counterpart: ``@fluidframework/presence`` (SURVEY.md §1 L5; mount
+empty): each client broadcasts its ephemeral state (cursor, selection,
+availability) as signals — never sequenced, never stored — and tracks the
+latest state per remote client, dropping clients that leave the quorum.
+
+Newly-connecting clients announce themselves and receive a re-broadcast
+from everyone (the join/refresh handshake), since signals have no history.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.protocol import SignalMessage
+
+_PRESENCE = "presence"
+_REFRESH = "presenceRefresh"
+
+
+class PresenceManager:
+    def __init__(self, container):
+        self._container = container
+        self._my_state: Optional[dict] = None
+        # client_id -> latest presence data
+        self.states: Dict[int, Any] = {}
+        self._listeners: List[Callable[[int, Any], None]] = []
+        container.on("signal", self._on_signal)
+        container.on("connected", self._on_connected)
+        container.quorum.on("removeMember", self._on_leave)
+
+    # ------------------------------------------------------------- local side
+
+    def set_presence(self, data: Any) -> None:
+        """Broadcast this client's ephemeral state (latest wins)."""
+        self._my_state = data
+        if self._container.connected:
+            self._container.submit_signal(
+                {"type": _PRESENCE, "data": data})
+
+    def _on_connected(self, _client_id: int) -> None:
+        # ask everyone to re-broadcast (we have no history), and announce us
+        self._container.submit_signal({"type": _REFRESH})
+        if self._my_state is not None:
+            self._container.submit_signal(
+                {"type": _PRESENCE, "data": self._my_state})
+
+    # ------------------------------------------------------------ remote side
+
+    def _on_signal(self, sig: SignalMessage) -> None:
+        contents = sig.contents
+        if not isinstance(contents, dict):
+            return
+        kind = contents.get("type")
+        if kind == _PRESENCE:
+            self.states[sig.client_id] = contents["data"]
+            for fn in list(self._listeners):
+                fn(sig.client_id, contents["data"])
+        elif kind == _REFRESH:
+            if sig.client_id != self._container.client_id \
+                    and self._my_state is not None:
+                self._container.submit_signal(
+                    {"type": _PRESENCE, "data": self._my_state})
+
+    def _on_leave(self, client_id: int) -> None:
+        if self.states.pop(client_id, None) is not None:
+            for fn in list(self._listeners):
+                fn(client_id, None)
+
+    # --------------------------------------------------------------- queries
+
+    def on_presence_changed(self, fn: Callable[[int, Any], None]) -> None:
+        """fn(client_id, data) — data is None when the client left."""
+        self._listeners.append(fn)
+
+    def get_presences(self, include_self: bool = False) -> Dict[int, Any]:
+        out = dict(self.states)
+        if not include_self:
+            out.pop(self._container.client_id, None)
+        return out

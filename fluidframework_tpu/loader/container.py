@@ -83,6 +83,7 @@ class Container:
         c.runtime = runtime_factory(c, runtime_summary)
         c.delta_manager.on("connected", c._on_connected)
         c.delta_manager.on("disconnected", c._on_disconnected)
+        c.delta_manager.on("signal", lambda sig: c._emit("signal", sig))
         c.state = ContainerState.LOADED
         if connect:
             c.connect()
@@ -140,6 +141,11 @@ class Container:
                address: Optional[str] = None) -> int:
         """Runtime-facing submit (reference: ContainerContext.submitFn)."""
         return self.delta_manager.submit(contents, type, address)
+
+    def submit_signal(self, contents: Any) -> None:
+        """Ephemeral broadcast to currently-connected clients (reference:
+        IContainer.submitSignal; listen via ``on("signal", fn)``)."""
+        self.delta_manager.submit_signal(contents)
 
     def propose(self, key: str, value: Any) -> None:
         """Quorum proposal (accepted once MSN passes its seq)."""

@@ -102,6 +102,7 @@ class DeltaManager:
         # drops the overlap and orders the rest
         conn.on_op(self._inbound.push)
         conn.on_nack(self._on_nack)
+        conn.on_signal(lambda sig: self._emit("signal", sig))
         self.catch_up()
         self.state = ConnectionState.CONNECTED
         self.reconnect_attempts = 0
@@ -165,6 +166,12 @@ class DeltaManager:
         return self.connection.submit(
             contents, type, ref_seq=self.last_sequence_number,
             address=address)
+
+    def submit_signal(self, contents: Any) -> None:
+        """Ephemeral broadcast (reference: submitSignal) — fire-and-forget,
+        silently dropped while disconnected (signals are best-effort)."""
+        if self.connection is not None and self.connected:
+            self.connection.submit_signal(contents)
 
     def submit_noop(self) -> None:
         """Heartbeat: advances this client's refSeq (and thus the MSN)

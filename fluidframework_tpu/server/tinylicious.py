@@ -15,7 +15,9 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
-from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..core.protocol import (
+    MessageType, SequencedDocumentMessage, SignalMessage,
+)
 from .deli import DeliSequencer, Nack
 from .oplog import PartitionedLog, partition_of
 from .services import Broadcaster, Historian, Scribe, Scriptorium
@@ -31,6 +33,7 @@ class DeltaConnection:
         self.client_id = client_id
         self._client_seq = 0
         self.listeners: List[Callable[[SequencedDocumentMessage], None]] = []
+        self.signal_listeners: List[Callable[[SignalMessage], None]] = []
         self.nacks: List[Nack] = []
         self.connected = True
 
@@ -46,6 +49,17 @@ class DeltaConnection:
 
     def on_op(self, fn: Callable[[SequencedDocumentMessage], None]) -> None:
         self.listeners.append(fn)
+
+    def submit_signal(self, contents: Any) -> None:
+        """Ephemeral broadcast: straight to connected clients, bypassing the
+        sequencing pipeline entirely (reference: signals ride the socket
+        layer, not Kafka)."""
+        assert self.connected, "signal on closed connection"
+        self.service._broadcast_signal(
+            SignalMessage(self.doc_id, self.client_id, contents))
+
+    def on_signal(self, fn: Callable[[SignalMessage], None]) -> None:
+        self.signal_listeners.append(fn)
 
     def disconnect(self) -> None:
         if self.connected:
@@ -103,6 +117,14 @@ class LocalService:
             leave = self.deli.client_leave(conn.doc_id, conn.client_id)
             if leave is not None:
                 self._publish(leave)
+
+    def _broadcast_signal(self, sig: SignalMessage) -> None:
+        """Fan a signal out to every connection on the document (including
+        the sender — reference behavior: you see your own signals)."""
+        for conn in list(self._connections.values()):
+            if conn.connected and conn.doc_id == sig.doc_id:
+                for fn in list(conn.signal_listeners):
+                    fn(sig)
 
     # -------------------------------------------------------------- pipeline
 

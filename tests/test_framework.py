@@ -1,0 +1,174 @@
+"""Framework layer (L5): DataObject lifecycle, fluid-static simple API,
+service client, signals + presence. Reference behaviors per SURVEY.md §1 L5."""
+
+from fluidframework_tpu.core.protocol import SignalMessage
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.framework import (
+    ContainerRuntimeFactoryWithDefaultDataObject, DataObject,
+    DataObjectFactory, FluidContainer, LocalClient, PresenceManager,
+)
+from fluidframework_tpu.loader import Container, Loader
+from fluidframework_tpu.server.tinylicious import LocalService
+
+
+# ------------------------------------------------------------------ signals
+
+class TestSignals:
+    def test_signal_broadcast_to_all_connected(self):
+        svc = LocalService()
+        client = LocalClient(service=svc)
+        c1, doc_id = client.create_container({"initialObjects": {}})
+        c2 = client.get_container(doc_id, {"initialObjects": {}})
+        got1, got2 = [], []
+        c1.on("signal", lambda s: got1.append((s.client_id, s.contents)))
+        c2.on("signal", lambda s: got2.append((s.client_id, s.contents)))
+        c1.submit_signal({"cursor": 5})
+        # both (including the sender) see it; it was never sequenced
+        assert got1 == got2 == [(c1.container.client_id, {"cursor": 5})]
+        assert all(m.contents != {"cursor": 5}
+                   for m in svc.get_deltas(doc_id))
+
+    def test_signals_not_stored_for_late_joiners(self):
+        client = LocalClient()
+        c1, doc_id = client.create_container({"initialObjects": {}})
+        c1.submit_signal("ephemeral")
+        late = client.get_container(doc_id, {"initialObjects": {}})
+        got = []
+        late.on("signal", lambda s: got.append(s))
+        assert got == []   # no history replay for signals
+
+
+# --------------------------------------------------------------- DataObject
+
+class TodoApp(DataObject):
+    created = 0
+    loaded = 0
+
+    def initializing_first_time(self):
+        TodoApp.created += 1
+        self.root.set("title", "untitled")
+        self.create_channel("items", "map")
+
+    def initializing_from_existing(self):
+        TodoApp.loaded += 1
+
+    @property
+    def items(self):
+        return self.get_channel("items")
+
+
+class TestDataObject:
+    def setup_method(self):
+        TodoApp.created = 0
+        TodoApp.loaded = 0
+
+    def test_lifecycle_first_time_vs_existing(self):
+        svc = LocalService()
+        factory = ContainerRuntimeFactoryWithDefaultDataObject(
+            DataObjectFactory("todo", TodoApp))
+        loader = Loader(LocalDocumentServiceFactory(svc), factory)
+        a = loader.resolve("doc")
+        app_a = factory.get_default(a.runtime)
+        assert TodoApp.created == 1
+        app_a.items.set("buy milk", False)
+        app_a.root.set("title", "groceries")
+
+        b = loader.resolve("doc")
+        app_b = factory.get_default(b.runtime)
+        assert TodoApp.created == 1 and TodoApp.loaded == 1
+        assert app_b.root.get("title") == "groceries"
+        assert app_b.items.get("buy milk") is False
+        app_b.items.set("buy milk", True)
+        assert app_a.items.get("buy milk") is True
+
+
+# ------------------------------------------------------------- fluid-static
+
+class TestFluidStatic:
+    SCHEMA = {"initialObjects": {"meta": "map", "text": "sharedString"}}
+
+    def test_create_and_get_container(self):
+        client = LocalClient()
+        c1, doc_id = client.create_container(self.SCHEMA)
+        c1.initial_objects["meta"].set("lang", "en")
+        c1.initial_objects["text"].insert_text(0, "hello")
+        c2 = client.get_container(doc_id, self.SCHEMA)
+        assert c2.initial_objects["meta"].get("lang") == "en"
+        assert c2.initial_objects["text"].get_text() == "hello"
+        c2.initial_objects["text"].insert_text(5, " world")
+        assert c1.initial_objects["text"].get_text() == "hello world"
+
+    def test_dynamic_objects_via_handles(self):
+        client = LocalClient()
+        c1, doc_id = client.create_container(self.SCHEMA)
+        counter = c1.create("counter")
+        counter.increment(3)
+        c1.initial_objects["meta"].set("counterRef",
+                                       FluidContainer.handle_of(counter))
+        c2 = client.get_container(doc_id, self.SCHEMA)
+        handle = c2.initial_objects["meta"].get("counterRef")
+        resolved = c2.resolve_handle(handle)
+        assert resolved.value == 3
+        resolved.increment(2)
+        assert counter.value == 5
+
+    def test_background_summarizer_trims_catchup(self):
+        from fluidframework_tpu.runtime import SummaryConfig
+        client = LocalClient(
+            summary_config=SummaryConfig(max_ops=5, max_time_s=1e9))
+        c1, doc_id = client.create_container(self.SCHEMA)
+        m = c1.initial_objects["meta"]
+        for i in range(25):
+            m.set(f"k{i}", i)
+        summary, seq, _ = client.service.latest_summary(doc_id)
+        assert summary is not None and seq > 0
+        late = client.get_container(doc_id, self.SCHEMA)
+        assert late.container.base_seq > 0        # loaded from summary
+        assert late.initial_objects["meta"].get("k24") == 24
+
+
+# ----------------------------------------------------------------- presence
+
+class TestPresence:
+    def test_presence_roundtrip_and_leave(self):
+        client = LocalClient()
+        c1, doc_id = client.create_container({"initialObjects": {}})
+        c2 = client.get_container(doc_id, {"initialObjects": {}})
+        p1, p2 = PresenceManager(c1.container), PresenceManager(c2.container)
+        p1.set_presence({"cursor": 10})
+        p2.set_presence({"cursor": 99})
+        assert p2.get_presences() == {c1.container.client_id: {"cursor": 10}}
+        assert p1.get_presences() == {c2.container.client_id: {"cursor": 99}}
+        changes = []
+        p1.on_presence_changed(lambda cid, d: changes.append((cid, d)))
+        cid2 = c2.container.client_id
+        c2.dispose()
+        assert (cid2, None) in changes
+        assert p1.get_presences() == {}
+
+    def test_late_joiner_gets_refresh(self):
+        client = LocalClient()
+        c1, doc_id = client.create_container({"initialObjects": {}})
+        p1 = PresenceManager(c1.container)
+        p1.set_presence({"user": "ada"})
+        c2 = client.get_container(doc_id, {"initialObjects": {}})
+        p2 = PresenceManager(c2.container)
+        # p2 was constructed after connect; trigger the handshake manually
+        # (the reference wires presence before connecting)
+        p2._on_connected(c2.container.client_id)
+        assert p2.get_presences() == {c1.container.client_id:
+                                      {"user": "ada"}}
+
+
+# ------------------------------------------------------------ examples (§2.19)
+
+class TestSharedTextExample:
+    def test_example_runs_and_converges(self):
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                            "shared_text.py")
+        spec = importlib.util.spec_from_file_location("shared_text", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main() == 0
