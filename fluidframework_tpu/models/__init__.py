@@ -20,6 +20,7 @@ from .interval_collection import IntervalCollection, SequenceInterval
 from .small_dds import (
     SharedCounter, SharedCell, RegisterCollection, ConsensusQueue, TaskManager,
 )
+from .shared_tree import SharedTree, TreeSchema
 
 __all__ = [
     "MergeTree", "Segment", "SegmentKind", "SlidePolicy", "LocalReference",
@@ -27,5 +28,5 @@ __all__ = [
     "ChannelRegistry", "default_registry", "SharedMap", "SharedDirectory",
     "MapKernel", "SharedString", "SharedMatrix", "IntervalCollection",
     "SequenceInterval", "SharedCounter", "SharedCell", "RegisterCollection",
-    "ConsensusQueue", "TaskManager",
+    "ConsensusQueue", "TaskManager", "SharedTree", "TreeSchema",
 ]

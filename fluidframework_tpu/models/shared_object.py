@@ -142,10 +142,11 @@ def default_registry() -> ChannelRegistry:
         SharedCounter, SharedCell, RegisterCollection,
         ConsensusQueue, TaskManager,
     )
+    from .shared_tree import SharedTree
 
     reg = ChannelRegistry()
     for cls in (SharedMap, SharedDirectory, SharedString, SharedMatrix,
                 SharedCounter, SharedCell, RegisterCollection,
-                ConsensusQueue, TaskManager):
+                ConsensusQueue, TaskManager, SharedTree):
         reg.register(ChannelFactory(cls.TYPE, cls))
     return reg

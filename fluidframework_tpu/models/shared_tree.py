@@ -1,0 +1,310 @@
+"""SharedTree: schema'd hierarchical tree DDS.
+
+Reference counterpart: ``@fluidframework/tree`` (``SharedTree``,
+``TreeView``, sequence/value fields, insert/remove/move edits, its own
+rebaser) — SURVEY.md §2.6 (mount empty; upstream's newest and largest DDS).
+
+Design (tree-native, not a port of the reference's commit-graph rebaser):
+
+- **Stable node ids** anchor every edit: an insert targets
+  ``(parent_id, field, after_sibling_id)``, never an index. Because ids
+  survive any concurrent edit, remote ops never invalidate a local op's
+  target — the reference's positional rebase machinery collapses to
+  "replay the pending op as-is". (This also keeps the future device
+  representation flat: a node-id-indexed struct-of-arrays table.)
+- **Convergence** = apply ops in total order against the **acked tree**;
+  the optimistic view is acked-tree ⊕ pending local ops, rebuilt by replay
+  whenever a remote op lands while local ops are in flight (the tree analog
+  of MapKernel's acked/optimistic split).
+- **Merge rules** (deterministic, documented here as the spec):
+  - concurrent inserts after the same anchor: the *later-sequenced* op's
+    nodes land closer to the anchor;
+  - a missing anchor (concurrently removed/moved sibling) degrades to
+    "start of field";
+  - edits under a concurrently-removed subtree are dropped;
+  - concurrent moves of one node: last-sequenced wins;
+  - a move that would create a cycle (target under the moved subtree after
+    merge) is dropped;
+  - ``set_value``: last-writer-wins.
+- **Schema**: optional ``TreeSchema`` validates node types and field names
+  at edit time (reference: SchemaFactory/view schema), not at merge time —
+  merged ops were validated by their submitter.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..core.protocol import SequencedDocumentMessage
+from .shared_object import SharedObject
+
+ROOT = "root"
+
+
+class TreeSchema:
+    """Allowed node types and their fields (reference: view schema)."""
+
+    def __init__(self, types: Dict[str, List[str]]):
+        # type name -> allowed sequence-field names
+        self.types = {t: list(fs) for t, fs in types.items()}
+
+    def check_node(self, node_type: Optional[str]) -> None:
+        if node_type is not None and node_type not in self.types:
+            raise ValueError(f"unknown node type {node_type!r}")
+
+    def check_field(self, node_type: Optional[str], field: str) -> None:
+        if node_type is not None and field not in self.types.get(node_type, ()):
+            raise ValueError(
+                f"type {node_type!r} has no field {field!r}")
+
+
+class _Tree:
+    """One materialized tree state: id-indexed nodes with ordered
+    per-field child lists. Pure data + total-order apply functions."""
+
+    def __init__(self):
+        self.nodes: Dict[str, dict] = {
+            ROOT: {"id": ROOT, "type": None, "value": None,
+                   "parent": None, "field": None, "children": {}}}
+
+    # ------------------------------------------------------------- mutation
+    # each returns True if the op applied (False = dropped by merge rules)
+
+    def apply(self, op: dict) -> bool:
+        kind = op["op"]
+        if kind == "insert":
+            return self._insert(op)
+        if kind == "remove":
+            return self._remove(op)
+        if kind == "move":
+            return self._move(op)
+        if kind == "setValue":
+            return self._set_value(op)
+        raise ValueError(f"unknown tree op {kind!r}")
+
+    def _attach_at_anchor(self, node_id: str, parent_id: str, field: str,
+                          after: Optional[str]) -> None:
+        siblings = self.nodes[parent_id]["children"].setdefault(field, [])
+        if after is not None and after in siblings:
+            idx = siblings.index(after) + 1
+        else:
+            idx = 0          # missing anchor degrades to start-of-field
+        siblings.insert(idx, node_id)
+        node = self.nodes[node_id]
+        node["parent"], node["field"] = parent_id, field
+
+    def _insert(self, op: dict) -> bool:
+        if op["parent"] not in self.nodes:
+            return False                 # parent concurrently removed
+        if any(n["id"] in self.nodes for n in op["nodes"]):
+            return False                 # duplicate delivery guard
+        after = op.get("after")
+        for spec in op["nodes"]:
+            self.nodes[spec["id"]] = {
+                "id": spec["id"], "type": spec.get("type"),
+                "value": spec.get("value"), "parent": None, "field": None,
+                "children": {}}
+            self._attach_at_anchor(spec["id"], op["parent"], op["field"],
+                                   after)
+            after = spec["id"]           # chain multi-node inserts in order
+        return True
+
+    def _detach(self, node_id: str) -> None:
+        node = self.nodes[node_id]
+        if node["parent"] is not None:
+            sibs = self.nodes[node["parent"]]["children"][node["field"]]
+            sibs.remove(node_id)
+        node["parent"] = node["field"] = None
+
+    def _remove(self, op: dict) -> bool:
+        node_id = op["id"]
+        if node_id not in self.nodes or node_id == ROOT:
+            return False                 # already gone / root immutable
+        self._detach(node_id)
+        for nid in list(self._subtree_ids(node_id)):
+            del self.nodes[nid]
+        return True
+
+    def _move(self, op: dict) -> bool:
+        node_id, parent_id = op["id"], op["parent"]
+        if node_id not in self.nodes or node_id == ROOT:
+            return False                 # moved node concurrently removed
+        if parent_id not in self.nodes:
+            return False                 # destination concurrently removed
+        if parent_id in self._subtree_ids(node_id):
+            return False                 # would create a cycle
+        self._detach(node_id)
+        self._attach_at_anchor(node_id, parent_id, op["field"],
+                               op.get("after"))
+        return True
+
+    def _set_value(self, op: dict) -> bool:
+        if op["id"] not in self.nodes:
+            return False
+        self.nodes[op["id"]]["value"] = op["value"]
+        return True
+
+    # -------------------------------------------------------------- queries
+
+    def _subtree_ids(self, node_id: str) -> Iterator[str]:
+        yield node_id
+        for field_children in self.nodes[node_id]["children"].values():
+            for child in field_children:
+                yield from self._subtree_ids(child)
+
+    def to_dict(self, node_id: str = ROOT) -> dict:
+        node = self.nodes[node_id]
+        out = {"id": node["id"], "type": node["type"], "value": node["value"]}
+        children = {f: [self.to_dict(c) for c in cs]
+                    for f, cs in sorted(node["children"].items()) if cs}
+        if children:
+            out["children"] = children
+        return out
+
+
+class TreeKernel:
+    """acked tree + optimistic overlay via pending-op replay."""
+
+    def __init__(self):
+        self.acked = _Tree()
+        self.view = self.acked            # shared until a local op diverges
+        self.pending: List[dict] = []     # local ops awaiting their echo
+
+    def local_op(self, op: dict) -> None:
+        if self.view is self.acked:
+            self.view = copy.deepcopy(self.acked)
+        self.view.apply(op)
+        self.pending.append(op)
+
+    def process(self, op: dict, local: bool) -> None:
+        self.acked.apply(op)
+        if local:
+            mine = self.pending.pop(0)
+            assert mine == op, "sequenced echo out of order vs pending"
+            if not self.pending:
+                self.view = self.acked    # fully acked: converged views
+            return
+        if self.pending:
+            # remote op landed under our in-flight ops: rebuild the
+            # optimistic view (ids are stable, so pending ops replay as-is)
+            view = copy.deepcopy(self.acked)
+            for p in self.pending:
+                view.apply(p)
+            self.view = view
+        else:
+            self.view = self.acked
+
+
+class SharedTree(SharedObject):
+    TYPE = "tree"
+
+    def __init__(self, object_id: str, client_id: int):
+        super().__init__(object_id, client_id)
+        self.kernel = TreeKernel()
+        self.schema: Optional[TreeSchema] = None
+        self._node_counter = 0
+
+    # ----------------------------------------------------------- public API
+
+    def set_schema(self, schema: TreeSchema) -> None:
+        self.schema = schema
+
+    def _new_id(self) -> str:
+        self._node_counter += 1
+        return f"n-{self.client_id}-{self._node_counter}"
+
+    def insert(self, parent_id: str, field: str,
+               node_type: Optional[str] = None, value: Any = None,
+               after: Optional[str] = None,
+               node_id: Optional[str] = None) -> str:
+        """Insert one node; returns its id. ``after=None`` → field start."""
+        if self.schema is not None:
+            self.schema.check_node(node_type)
+            parent = self.kernel.view.nodes[parent_id]
+            self.schema.check_field(parent["type"], field)
+        nid = node_id or self._new_id()
+        op = {"op": "insert", "parent": parent_id, "field": field,
+              "after": after,
+              "nodes": [{"id": nid, "type": node_type, "value": value}]}
+        self.kernel.local_op(op)
+        self.submit_local_message(op)
+        return nid
+
+    def remove(self, node_id: str) -> None:
+        op = {"op": "remove", "id": node_id}
+        self.kernel.local_op(op)
+        self.submit_local_message(op)
+
+    def move(self, node_id: str, new_parent: str, field: str,
+             after: Optional[str] = None) -> None:
+        op = {"op": "move", "id": node_id, "parent": new_parent,
+              "field": field, "after": after}
+        self.kernel.local_op(op)
+        self.submit_local_message(op)
+
+    def set_value(self, node_id: str, value: Any) -> None:
+        op = {"op": "setValue", "id": node_id, "value": value}
+        self.kernel.local_op(op)
+        self.submit_local_message(op)
+
+    # --------------------------------------------------------------- queries
+
+    def node(self, node_id: str) -> dict:
+        return self.kernel.view.nodes[node_id]
+
+    def has_node(self, node_id: str) -> bool:
+        return node_id in self.kernel.view.nodes
+
+    def children(self, node_id: str, field: str) -> List[str]:
+        return list(self.kernel.view.nodes[node_id]["children"]
+                    .get(field, ()))
+
+    def value_of(self, node_id: str) -> Any:
+        return self.kernel.view.nodes[node_id]["value"]
+
+    def to_dict(self) -> dict:
+        return self.kernel.view.to_dict()
+
+    def __len__(self) -> int:
+        return len(self.kernel.view.nodes)
+
+    # --------------------------------------------------------- DDS plumbing
+
+    def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
+        self.kernel.process(msg.contents, local)
+
+    def rebase_op(self, contents: dict) -> Optional[dict]:
+        # id-anchored ops are position-free: resubmit unchanged (see module
+        # docstring — this is the design's payoff)
+        return contents
+
+    def apply_stashed_op(self, contents: dict) -> None:
+        self.kernel.local_op(contents)
+
+    def summarize(self) -> dict:
+        return {"type": self.TYPE, "nodes": {
+            nid: {"type": n["type"], "value": n["value"],
+                  "parent": n["parent"], "field": n["field"],
+                  "children": {f: list(cs)
+                               for f, cs in n["children"].items() if cs}}
+            for nid, n in self.kernel.acked.nodes.items()}}
+
+    def load_core(self, summary: dict) -> None:
+        tree = _Tree()
+        tree.nodes = {}
+        for nid, nd in summary["nodes"].items():
+            tree.nodes[nid] = {
+                "id": nid, "type": nd["type"], "value": nd["value"],
+                "parent": nd["parent"], "field": nd["field"],
+                "children": {f: list(cs)
+                             for f, cs in nd.get("children", {}).items()}}
+        if ROOT not in tree.nodes:
+            tree.nodes[ROOT] = {"id": ROOT, "type": None, "value": None,
+                                "parent": None, "field": None, "children": {}}
+        self.kernel.acked = tree
+        self.kernel.view = tree
+
+    def digest(self) -> str:
+        import json
+        return json.dumps(self.kernel.acked.to_dict(), sort_keys=True)

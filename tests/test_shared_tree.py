@@ -1,0 +1,213 @@
+"""SharedTree DDS: id-anchored edits, merge rules, pending-op replay,
+convergence fuzz. Reference behaviors per SURVEY.md §2.6."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.models import SharedTree, TreeSchema
+from fluidframework_tpu.testing.mocks import MockSequencer, \
+    create_connected_dds
+
+
+def make_trees(n=2):
+    seqr = MockSequencer()
+    trees = [create_connected_dds(seqr, SharedTree, "t") for _ in range(n)]
+    return seqr, trees
+
+
+def digests(trees):
+    return {t.digest() for t in trees}
+
+
+# -------------------------------------------------------------- basic edits
+
+class TestBasicEdits:
+    def test_insert_children_and_values(self):
+        seqr, (a, b) = make_trees()
+        n1 = a.insert("root", "items", node_type=None, value="first")
+        n2 = a.insert("root", "items", value="second", after=n1)
+        seqr.process_all_messages()
+        assert b.children("root", "items") == [n1, n2]
+        assert b.value_of(n2) == "second"
+
+    def test_remove_subtree(self):
+        seqr, (a, b) = make_trees()
+        parent = a.insert("root", "items", value="p")
+        child = a.insert(parent, "kids", value="c")
+        seqr.process_all_messages()
+        b.remove(parent)
+        seqr.process_all_messages()
+        assert not a.has_node(parent) and not a.has_node(child)
+        assert len(a) == len(b) == 1   # just root
+
+    def test_move_between_parents(self):
+        seqr, (a, b) = make_trees()
+        p1 = a.insert("root", "items", value="p1")
+        p2 = a.insert("root", "items", value="p2", after=p1)
+        x = a.insert(p1, "kids", value="x")
+        seqr.process_all_messages()
+        b.move(x, p2, "kids")
+        seqr.process_all_messages()
+        assert a.children(p1, "kids") == []
+        assert a.children(p2, "kids") == [x]
+        assert digests((a, b)) == {a.digest()}
+
+    def test_set_value_lww(self):
+        seqr, (a, b) = make_trees()
+        n = a.insert("root", "items", value=0)
+        seqr.process_all_messages()
+        a.set_value(n, "from-a")
+        b.set_value(n, "from-b")
+        seqr.process_all_messages()
+        # b's op sequenced second → wins on both replicas
+        assert a.value_of(n) == b.value_of(n) == "from-b"
+
+
+# -------------------------------------------------------------- merge rules
+
+class TestMergeRules:
+    def test_concurrent_inserts_same_anchor_later_seq_closer(self):
+        seqr, (a, b) = make_trees()
+        anchor = a.insert("root", "items", value="anchor")
+        seqr.process_all_messages()
+        na = a.insert("root", "items", value="A", after=anchor)
+        nb = b.insert("root", "items", value="B", after=anchor)
+        seqr.process_all_messages()   # a's sequenced first
+        # later-sequenced (b) lands closer to the anchor
+        assert a.children("root", "items") == [anchor, nb, na]
+        assert digests((a, b)) == {a.digest()}
+
+    def test_edit_under_concurrently_removed_subtree_dropped(self):
+        seqr, (a, b) = make_trees()
+        p = a.insert("root", "items", value="p")
+        seqr.process_all_messages()
+        a.remove(p)
+        nb = b.insert(p, "kids", value="orphan")   # concurrent with removal
+        seqr.process_all_messages()
+        assert not a.has_node(nb) and not b.has_node(nb)
+        assert digests((a, b)) == {a.digest()}
+
+    def test_concurrent_moves_last_sequenced_wins(self):
+        seqr, (a, b) = make_trees()
+        p1 = a.insert("root", "items", value="p1")
+        p2 = a.insert("root", "items", value="p2", after=p1)
+        x = a.insert("root", "items", value="x", after=p2)
+        seqr.process_all_messages()
+        a.move(x, p1, "kids")
+        b.move(x, p2, "kids")
+        seqr.process_all_messages()
+        assert a.children(p2, "kids") == [x]     # b sequenced last → wins
+        assert a.children(p1, "kids") == []
+        assert digests((a, b)) == {a.digest()}
+
+    def test_cycle_creating_move_dropped(self):
+        seqr, (a, b) = make_trees()
+        p = a.insert("root", "items", value="p")
+        c = a.insert(p, "kids", value="c")
+        seqr.process_all_messages()
+        # concurrently: a moves c under root, b moves p under c (cycle if
+        # both applied naively)
+        a.move(c, "root", "items")
+        b.move(p, c, "kids")
+        seqr.process_all_messages()
+        assert digests((a, b)) == {a.digest()}
+        # p under c applied after c moved to root: no cycle, both survive
+        assert a.children(c, "kids") == [p]
+
+    def test_direct_self_cycle_dropped(self):
+        seqr, (a, b) = make_trees()
+        p = a.insert("root", "items", value="p")
+        c = a.insert(p, "kids", value="c")
+        seqr.process_all_messages()
+        b.move(p, c, "kids")       # p under its own child, sequenced alone
+        seqr.process_all_messages()
+        assert a.children("root", "items") == [p]   # dropped
+        assert digests((a, b)) == {a.digest()}
+
+    def test_missing_anchor_degrades_to_field_start(self):
+        seqr, (a, b) = make_trees()
+        s1 = a.insert("root", "items", value="s1")
+        s2 = a.insert("root", "items", value="s2", after=s1)
+        seqr.process_all_messages()
+        a.remove(s1)
+        nb = b.insert("root", "items", value="n", after=s1)  # anchor dying
+        seqr.process_all_messages()
+        assert a.children("root", "items") == [nb, s2]
+        assert digests((a, b)) == {a.digest()}
+
+
+# ------------------------------------------------------------------- schema
+
+class TestSchema:
+    def test_schema_validates_types_and_fields(self):
+        seqr, (a, b) = make_trees()
+        schema = TreeSchema({"list": ["items"], "item": []})
+        a.set_schema(schema)
+        lst = a.insert("root", "items", node_type=None)  # untyped parent ok
+        with pytest.raises(ValueError):
+            a.insert("root", "items", node_type="nosuch")
+        n = a.insert(lst, "x", node_type="item")  # untyped parent: any field
+        seqr.process_all_messages()
+        assert b.has_node(n)
+
+    def test_schema_rejects_bad_field_on_typed_parent(self):
+        seqr, (a, _) = make_trees()
+        a.set_schema(TreeSchema({"list": ["items"]}))
+        lst = a.insert("root", "x", node_type="list")
+        with pytest.raises(ValueError):
+            a.insert(lst, "wrong", value=1)
+        a.insert(lst, "items", value=1)   # allowed
+
+
+# -------------------------------------------------------- summaries + fuzz
+
+class TestSummariesAndFuzz:
+    def test_summary_roundtrip(self):
+        seqr, (a, b) = make_trees()
+        p = a.insert("root", "items", value="p")
+        a.insert(p, "kids", value="k")
+        seqr.process_all_messages()
+        fresh = SharedTree("t", 99)
+        fresh.load_core(a.summarize())
+        assert fresh.digest() == a.digest()
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_convergence_fuzz(self, seed):
+        rng = random.Random(seed)
+        seqr, trees = make_trees(3)
+        for t in trees:
+            t._fuzz_nodes = ["root"]
+
+        def random_edit(t):
+            kind = rng.choice(["insert", "insert", "insert", "remove",
+                               "move", "setValue"])
+            live = [n for n in t._fuzz_nodes if t.has_node(n)]
+            if not live:
+                live = ["root"]
+            if kind == "insert":
+                parent = rng.choice(live)
+                sibs = t.children(parent, "f")
+                after = rng.choice([None] + sibs) if sibs else None
+                nid = t.insert(parent, "f", value=rng.randint(0, 99),
+                               after=after)
+                t._fuzz_nodes.append(nid)
+            elif kind == "remove":
+                target = rng.choice(live)
+                if target != "root":
+                    t.remove(target)
+            elif kind == "move":
+                target, dest = rng.choice(live), rng.choice(live)
+                if target != "root":
+                    t.move(target, dest, "f")
+            else:
+                t.set_value(rng.choice(live), rng.randint(0, 99))
+
+        for _ in range(30):
+            for t in trees:
+                if rng.random() < 0.7:
+                    random_edit(t)
+            # partial sequencing so ops cross in flight
+            seqr.process_some(rng.randint(0, 4))
+        seqr.process_all_messages()
+        assert len(digests(trees)) == 1, f"diverged at seed {seed}"
