@@ -148,6 +148,140 @@ class SequenceClient:
         else:
             raise ValueError(f"unknown merge-tree op {op['mt']!r}")
 
+    # ------------------------------------------------- reconnect regeneration
+
+    def set_client_id(self, new_client_id: int) -> None:
+        """Adopt a reconnect's new client id: re-stamp pending segments and
+        pending removers (acked stamps are history and stay)."""
+        old = self.client_id
+        if new_client_id == old:
+            return
+        for seg in self.tree.segments:
+            if seg.client == old and seg.seq == SEQ_UNASSIGNED:
+                seg.client = new_client_id
+            if old in seg.removers and seg.removed_seq == SEQ_UNASSIGNED:
+                seg.removers[seg.removers.index(old)] = new_client_id
+        self.client_id = new_client_id
+        self.tree.local_client = new_client_id
+
+    def _visible_at_local(self, seg, k: int) -> bool:
+        """Visibility in the perspective a receiver will have when our
+        pending op ``k`` applies after resubmission: everything acked, plus
+        our pending ops with smaller local ids (they are resubmitted, and
+        therefore sequenced, before op ``k``)."""
+        inserted = seg.seq != SEQ_UNASSIGNED or (
+            seg.local_insert_op is not None and seg.local_insert_op < k)
+        if not inserted:
+            return False
+        if seg.removed_seq is None:
+            return True
+        if seg.removed_seq != SEQ_UNASSIGNED:
+            return False                       # acked remove
+        return not (seg.local_remove_op is not None
+                    and seg.local_remove_op < k)
+
+    def regenerate_pending_ops(self, new_client_id=None):
+        """Rebase every pending local op for resubmission on a new
+        connection (reference: Client resubmit / segment-group regeneration;
+        SURVEY.md §3.3 — correctness-critical). Returns
+        ``{old_client_seq: [new op contents, ...]}`` in pending-FIFO order.
+
+        Positions are recomputed per op from its *pending segments* in the
+        local-seq perspective (acked state + earlier pending ops), so remote
+        ops merged while offline are accounted for. One old op can become
+        several (its segments were split apart by interleaved state) or none
+        (its whole range was concurrently removed). Pending bookkeeping and
+        segment stamps are renumbered onto fresh client seqs; with
+        ``new_client_id`` the pending segments are re-stamped first (a new
+        connection means a new client id)."""
+        if new_client_id is not None:
+            self.set_client_id(new_client_id)
+
+        out = {}
+        plans = []    # (old_id, kind, [(contents_sans_id, run_segments)])
+        for k, kind in self.pending:
+            plans.append((k, kind, self._regen_one(k, kind)))
+        self.pending.clear()
+        for k, kind, runs in plans:
+            ops = []
+            for contents, run_segs in runs:
+                self.client_seq += 1
+                nid = self.client_seq
+                contents["clientSeq"] = nid
+                for seg in run_segs:
+                    if kind == "insert":
+                        seg.local_insert_op = nid
+                    elif kind == "remove":
+                        seg.local_remove_op = nid
+                    elif kind == "annotate":
+                        seg.pending_annotates = [
+                            (nid, p) if op_id == k else (op_id, p)
+                            for op_id, p in seg.pending_annotates]
+                self.pending.append((nid, kind))
+                ops.append(contents)
+            out[k] = ops
+        return out
+
+    def _regen_one(self, k: int, kind: str):
+        """Plan the regenerated ops for pending op ``k``: contiguous runs of
+        its segments in the perspective of op ``k``, with positions adjusted
+        for this op's own earlier runs (receivers apply them first)."""
+        runs = []
+        pos = 0               # perspective-k prefix length at current segment
+        cur = None            # (start_pos, segments) of the open run
+        emitted = 0           # total length of earlier runs of this op
+
+        def mine(seg) -> bool:
+            if kind == "insert":
+                return seg.local_insert_op == k
+            if kind == "remove":
+                return seg.local_remove_op == k \
+                    and seg.removed_seq == SEQ_UNASSIGNED
+            return any(op_id == k for op_id, _ in seg.pending_annotates) \
+                and self._visible_at_local(seg, k)
+
+        def close_run():
+            nonlocal cur, emitted
+            if cur is None:
+                return
+            start, segs = cur
+            length = sum(s.length for s in segs)
+            if kind == "insert":
+                runs.append(({"mt": "insert", "pos": start + emitted,
+                              "kind": int(segs[0].kind),
+                              "text": "".join(s.text for s in segs),
+                              "props": dict(segs[0].props) or None},
+                             segs))
+                emitted += length
+            elif kind == "remove":
+                runs.append(({"mt": "remove", "start": start - emitted,
+                              "end": start - emitted + length}, segs))
+                emitted += length
+            else:
+                props = next(p for op_id, p in segs[0].pending_annotates
+                             if op_id == k)
+                runs.append(({"mt": "annotate", "start": start,
+                              "end": start + length, "props": props}, segs))
+            cur = None
+
+        for seg in self.tree.segments:
+            if mine(seg):
+                if cur is None:
+                    cur = (pos, [seg])
+                else:
+                    cur[1].append(seg)
+                # remove/annotate targets are perspective-k visible and
+                # consume width; insert's own segments are not yet visible
+                if kind != "insert":
+                    pos += seg.length
+            else:
+                if self._visible_at_local(seg, k):
+                    close_run()    # a visible foreign segment breaks the run
+                    pos += seg.length
+                # invisible segments (later pending ops) don't break runs
+        close_run()
+        return runs
+
     # ----------------------------------------------------------------- views
 
     def get_text(self) -> str:

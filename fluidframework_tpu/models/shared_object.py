@@ -67,13 +67,19 @@ class SharedObject:
         self.last_processed_seq = msg.seq
         self.on_min_seq(msg.min_seq)
 
-    def rebase_op(self, contents: dict) -> Optional[dict]:
+    def rebase_op(self, contents: dict):
         """Rebase one pending local op for resubmission after reconnect
         (reference: SharedObject.reSubmit). Returns the contents to resend —
         unchanged by default, which is correct for position-independent ops
         (map/counter/register...); sequence DDSes override to re-resolve
-        positions against the current state. Return None to drop the op."""
+        positions against the current state. Return None to drop the op, or
+        a list when one op regenerates into several."""
         return contents
+
+    def on_client_id_changed(self, new_client_id: int) -> None:
+        """A reconnect assigned a new client id; channels with deeper
+        client-id state (merge-tree segment stamps) override and re-stamp."""
+        self.client_id = new_client_id
 
     def apply_stashed_op(self, contents: dict) -> None:
         """Re-apply a stashed (previously submitted, never sequenced) local

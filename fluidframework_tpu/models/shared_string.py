@@ -39,6 +39,8 @@ class SharedString(SharedObject):
         # submit) change cannot clobber a newer local change at its ack
         self._iv_ticket = 0
         self._iv_last_ticket: Dict[tuple, int] = {}
+        # {old clientSeq: [regenerated ops]} during a reconnect resubmit
+        self._regen_cache: Optional[Dict[int, list]] = None
 
     @property
     def tree(self) -> MergeTree:
@@ -197,6 +199,52 @@ class SharedString(SharedObject):
     def on_min_seq(self, min_seq: int) -> None:
         if min_seq > self.tree.min_seq:
             self.tree.zamboni(min_seq)
+
+    # ----------------------------------------------------- reconnect rebasing
+
+    def on_client_id_changed(self, new_client_id: int) -> None:
+        super().on_client_id_changed(new_client_id)
+        self.client.set_client_id(new_client_id)
+
+    def rebase_op(self, contents: dict):
+        """Reconnect resubmission (§3.3, correctness-critical): merge-tree
+        ops are regenerated from their pending segments — positions
+        re-resolved against everything merged while offline, one op per
+        contiguous surviving run (an op whose whole range was concurrently
+        removed drops). Interval ops re-resolve endpoints from their local
+        references. The runtime drains pending records in FIFO order, so the
+        first merge-tree record triggers one whole-queue regeneration."""
+        if "mt" in contents:
+            if self._regen_cache is None:
+                self._regen_cache = self.client.regenerate_pending_ops()
+            ops = self._regen_cache.pop(contents["clientSeq"], None)
+            assert ops is not None, "rebase for unknown pending op"
+            if not self._regen_cache:
+                self._regen_cache = None
+            return ops or None
+        if "iv" in contents:
+            return self._rebase_interval(contents)
+        return contents
+
+    def _rebase_interval(self, op: dict):
+        if op["iv"] == "delete":
+            return op
+        coll = self._collections.get(op["label"])
+        iv = coll.get(op["id"]) if coll is not None else None
+        if iv is None:
+            # add whose interval was deleted locally while in flight: the
+            # delete op follows in the queue; resend the add as recorded
+            return op if op["iv"] == "add" else None
+        start, end = coll.endpoints(iv)
+        out = dict(op)
+        if op["iv"] == "add":
+            out["start"], out["end"] = start, end
+        else:  # change: only re-resolve the fields the op touches
+            if op.get("start") is not None:
+                out["start"] = start
+            if op.get("end") is not None:
+                out["end"] = end
+        return out
 
     # ------------------------------------------------------------- summaries
 

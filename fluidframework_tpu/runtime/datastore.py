@@ -80,7 +80,7 @@ class FluidDataStoreRuntime:
         """New connection: channels stamp local ops with the new id."""
         self.client_id = client_id
         for ch in self._channels.values():
-            ch.client_id = client_id
+            ch.on_client_id_changed(client_id)
 
     # ---------------------------------------------------------------- inbound
 
@@ -97,11 +97,16 @@ class FluidDataStoreRuntime:
 
     def resubmit(self, inner: dict, metadata: Optional[dict] = None) -> None:
         """Reconnect path: let the channel rebase, then resend with the
-        original local-op metadata preserved (§3.3)."""
+        original local-op metadata preserved (§3.3). A rebase may drop the
+        op (None) or split it into several (list)."""
         channel = self.get_channel(inner["address"])
         rebased = channel.rebase_op(inner["contents"])
-        if rebased is not None:
-            self._submit({"address": channel.id, "contents": rebased},
+        if rebased is None:
+            return
+        if isinstance(rebased, dict):
+            rebased = [rebased]
+        for contents in rebased:
+            self._submit({"address": channel.id, "contents": contents},
                          metadata)
 
     def on_min_seq(self, min_seq: int) -> None:

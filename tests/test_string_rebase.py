@@ -1,0 +1,165 @@
+"""SharedString reconnect rebasing (SURVEY.md §3.3 — correctness-critical):
+pending merge-tree ops regenerated against state merged while offline."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.drivers import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.tinylicious import LocalService
+
+
+def make_pair():
+    svc = LocalService()
+    loader = Loader(LocalDocumentServiceFactory(svc),
+                    ContainerRuntime.factory())
+    a = loader.resolve("doc")
+    b = loader.resolve("doc")
+    sa = a.runtime.create_data_store("default") \
+        .create_channel("text", "sharedString")
+    sb = b.runtime.get_data_store("default").get_channel("text")
+    return a, b, sa, sb
+
+
+def converged(sa, sb):
+    assert sa.get_text() == sb.get_text(), \
+        f"diverged: {sa.get_text()!r} vs {sb.get_text()!r}"
+    assert sa.tree.structure_digest() == sb.tree.structure_digest()
+    return sa.get_text()
+
+
+class TestInsertRebase:
+    def test_offline_insert_repositioned_after_remote_prefix(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "world")
+        a.disconnect("net")
+        sa.insert_text(5, "!")            # offline, at end
+        sb.insert_text(0, "hello ")       # sequenced while a offline
+        a.connect()
+        assert converged(sa, sb) == "hello world!"
+
+    def test_offline_insert_into_remotely_removed_context(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "abcdef")
+        a.disconnect("net")
+        sa.insert_text(3, "XY")           # between c and d
+        sb.remove_text(1, 5)              # remove bcde (around the insert pt)
+        a.connect()
+        # a's text lands at the collapsed position; nothing lost
+        assert converged(sa, sb) == "aXYf"
+
+    def test_multiple_offline_inserts_keep_relative_order(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "13")
+        a.disconnect("net")
+        sa.insert_text(1, "2")            # 123
+        sa.insert_text(3, "4")            # 1234
+        sb.insert_text(0, "0")            # 013 for b
+        a.connect()
+        assert converged(sa, sb) == "01234"
+
+
+class TestRemoveRebase:
+    def test_offline_remove_skips_text_typed_inside_range(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "delete this please")
+        a.disconnect("net")
+        sa.remove_text(0, 11)             # "delete this" pending remove
+        sb.insert_text(7, "NEW ")         # typed inside the doomed range
+        a.connect()
+        # the regenerated removes must not eat b's concurrent text
+        assert converged(sa, sb) == "NEW  please"
+
+    def test_offline_remove_overlapping_remote_remove(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "abcdefgh")
+        a.disconnect("net")
+        sa.remove_text(2, 6)              # cdef
+        sb.remove_text(4, 8)              # efgh (overlaps)
+        a.connect()
+        assert converged(sa, sb) == "ab"
+
+    def test_offline_remove_fully_superseded_by_remote_remove(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "abcdef")
+        a.disconnect("net")
+        sa.remove_text(2, 4)              # cd
+        sb.remove_text(0, 6)              # everything
+        a.connect()                        # a's remove regenerates to nothing
+        assert converged(sa, sb) == ""
+
+
+class TestAnnotateRebase:
+    def test_offline_annotate_follows_its_text(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "plain bold")
+        a.disconnect("net")
+        sa.annotate_range(6, 10, {"weight": "bold"})
+        sb.insert_text(0, ">>> ")
+        a.connect()
+        assert converged(sa, sb) == ">>> plain bold"
+        # the annotation moved with the text on BOTH replicas
+        for s in (sa, sb):
+            assert s.get_properties(10) == {"weight": "bold"}
+            assert s.get_properties(5) == {}
+
+
+class TestIntervalRebase:
+    def test_offline_interval_add_reanchors(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "mark this span")
+        a.disconnect("net")
+        iva = sa.get_interval_collection("c")
+        iva.add(5, 9, {"note": "x"})      # "this"
+        sb.insert_text(0, "## ")
+        a.connect()
+        converged(sa, sb)
+        ivb = sb.get_interval_collection("c")
+        (iv,) = ivb.find_overlapping(0, sb.get_length())
+        s, e = ivb.endpoints(iv.interval_id)
+        assert sb.get_text()[s:e + 1].startswith("this")
+
+
+class TestMixedFuzz:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_offline_edit_storm_converges(self, seed):
+        rng = random.Random(seed)
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "the quick brown fox jumps over the lazy dog")
+
+        def edit(s):
+            n = s.get_length()
+            kind = rng.choice(["ins", "ins", "del", "ann"])
+            if kind == "ins" or n < 4:
+                s.insert_text(rng.randint(0, n), rng.choice(
+                    ["X", "yy", "zzz", " "]))
+            elif kind == "del":
+                i = rng.randint(0, n - 2)
+                j = rng.randint(i + 1, min(n, i + 5))
+                s.remove_text(i, j)
+            else:
+                i = rng.randint(0, n - 2)
+                j = rng.randint(i + 1, min(n, i + 4))
+                s.annotate_range(i, j, {"k": rng.randint(0, 9)})
+
+        a.disconnect("net")
+        for _ in range(6):
+            edit(sa)                      # offline edits pile up pending
+        for _ in range(6):
+            edit(sb)                      # sequenced meanwhile
+        a.connect()
+        converged(sa, sb)
+
+    def test_double_disconnect_cycle(self):
+        a, b, sa, sb = make_pair()
+        sa.insert_text(0, "abc")
+        a.disconnect("1")
+        sa.insert_text(3, "def")
+        a.connect()
+        a.disconnect("2")
+        sa.remove_text(0, 2)
+        sb.insert_text(3, "-mid-")
+        a.connect()
+        assert converged(sa, sb) == "c-mid-def"
