@@ -19,8 +19,13 @@ Design invariants that make this tractable on a TPU:
   acked segment has seq < the incoming op's seq. Later-sequenced concurrent
   inserts therefore land left of earlier ones, exactly like the oracle.
 - **Position-ordered dense slots.** Active segments occupy slots 0..n-1 in
-  document order. Inserts/splits rebuild the slot arrays with one gather
-  (O(S) vector work per op per doc — vector lanes, not pointer chases).
+  document order. An insert or split always shifts the tail of the slot
+  arrays right by 1 or 2, so every plane update is a ``roll`` plus masked
+  selects — pure vector passes, **no general gather/scatter** (dynamic
+  gathers lower to scalar loops on TPU and measure ~1000× slower here).
+  Scalar extractions (the containing slot's prefix) use one-hot masked
+  reductions for the same reason; compaction sorts all planes together
+  with a multi-operand ``lax.sort`` instead of argsort + gather.
 - **Client indexes + remover bitmask.** Clients of a doc are interned to
   indexes 0..31 by the host; "removed by client c" (needed for perspectives
   whose refSeq predates the client's own removal) is one bit in an int32
@@ -105,7 +110,15 @@ _PLANES = ("seq", "client", "removed_seq", "removers", "length",
 
 
 def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq):
-    """Apply one insert to one doc (S-vector planes in dict s)."""
+    """Apply one insert to one doc (S-vector planes in dict s).
+
+    Gather-free: the result is ``s`` below the cut slot, ``roll(s, 1)``
+    (boundary insert) or ``roll(s, 2)`` (split) above it, with the new
+    segment written at the cut and the split's right piece fixed up in
+    place — ``roll(s, 2)`` already carries the containing slot's values
+    to the right-piece position. Wrapped roll values only ever land on
+    slots that are overwritten or beyond ``count``.
+    """
     S = s["seq"].shape[0]
     i = jnp.arange(S)
     vis = _visible(s, ref_seq, client_idx)
@@ -114,7 +127,7 @@ def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq):
     inside = vis & (pre < pos) & (pos < end)
     has_inside = jnp.any(inside)
     j = jnp.argmax(inside)                      # containing slot (split case)
-    off = pos - pre[j]
+    off = pos - jnp.sum(jnp.where(inside, pre, 0))   # pre[j], one-hot sum
 
     bcand = _active(s, S) & (pre >= pos)
     idx_b = jnp.where(jnp.any(bcand), jnp.argmax(bcand), s["count"])
@@ -124,32 +137,29 @@ def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq):
     would_overflow = new_count > S
 
     new_slot = jnp.where(has_inside, j + 1, idx_b)
-    src = jnp.where(
-        has_inside,
-        jnp.where(i <= j, i, jnp.where(i == j + 2, j, i - 2)),
-        jnp.where(i < idx_b, i, i - 1),
-    )
-    src = jnp.clip(src, 0, S - 1)
-
-    out = {k: s[k][src] for k in _PLANES}
     is_new = i == new_slot
-    is_left = has_inside & (i == j)
-    is_right = has_inside & (i == j + 2)
+    is_right = has_inside & (i == new_slot + 1)   # split right piece
+    is_left = has_inside & (i == j)               # split left piece
+    below = i < new_slot
 
+    out = {}
+    for k in _PLANES:
+        shifted = jnp.where(has_inside, jnp.roll(s[k], 2), jnp.roll(s[k], 1))
+        out[k] = jnp.where(below, s[k], shifted)
+
+    # base values at is_right are the containing slot's (via roll-by-2)
     out["length"] = jnp.where(
         is_new, length,
         jnp.where(is_left, off,
-                  jnp.where(is_right, s["length"][j] - off, out["length"])))
+                  jnp.where(is_right, out["length"] - off, out["length"])))
     out["handle_off"] = jnp.where(
         is_new, 0,
-        jnp.where(is_right, s["handle_off"][j] + off, out["handle_off"]))
+        jnp.where(is_right, out["handle_off"] + off, out["handle_off"]))
     out["handle_op"] = jnp.where(is_new, handle, out["handle_op"])
     out["seq"] = jnp.where(is_new, seq, out["seq"])
     out["client"] = jnp.where(is_new, client_idx, out["client"])
     out["removed_seq"] = jnp.where(is_new, NOT_REMOVED, out["removed_seq"])
     out["removers"] = jnp.where(is_new, 0, out["removers"])
-    out["count"] = new_count
-    out["overflow"] = s["overflow"]
 
     # overflow: leave the doc untouched, set the sticky flag
     res = {k: jnp.where(would_overflow, s[k], out[k]) for k in _PLANES}
@@ -167,22 +177,23 @@ def _split_at(s, p, ref_seq, client_idx):
     inside = vis & (pre < p) & (p < end)
     has_inside = jnp.any(inside)
     j = jnp.argmax(inside)
-    off = p - pre[j]
+    off = p - jnp.sum(jnp.where(inside, pre, 0))     # pre[j], one-hot sum
 
     new_count = s["count"] + 1
     would_overflow = new_count > S
     do = has_inside & ~would_overflow
 
-    src = jnp.where(i <= j, i, jnp.where(i == j + 1, j, i - 1))
-    src = jnp.clip(src, 0, S - 1)
-    out = {k: s[k][src] for k in _PLANES}
+    # gather-free: roll(s, 1) already carries slot j's values to j+1
     is_left = i == j
     is_right = i == j + 1
+    out = {}
+    for k in _PLANES:
+        out[k] = jnp.where(i <= j, s[k], jnp.roll(s[k], 1))
     out["length"] = jnp.where(
         is_left, off,
-        jnp.where(is_right, s["length"][j] - off, out["length"]))
+        jnp.where(is_right, out["length"] - off, out["length"]))
     out["handle_off"] = jnp.where(
-        is_right, s["handle_off"][j] + off, out["handle_off"])
+        is_right, out["handle_off"] + off, out["handle_off"])
 
     res = {k: jnp.where(do, out[k], s[k]) for k in _PLANES}
     res["count"] = jnp.where(do, new_count, s["count"])
@@ -272,16 +283,19 @@ def compact_string_state(state: StringState, min_seq) -> StringState:
     sd = _state_dict(state)
     S = state.seq.shape[1]
 
-    def one(s, ms):
-        active = jnp.arange(S) < s["count"]
-        keep = active & ~(s["removed_seq"] <= ms)
-        perm = jnp.argsort(~keep, stable=True)
-        out = {k: s[k][perm] for k in _PLANES}
-        out["count"] = jnp.sum(keep.astype(jnp.int32))
-        out["overflow"] = s["overflow"]
-        return out
-
-    return StringState(**jax.vmap(one)(sd, min_seq))
+    # Gather-free stable partition: sort every plane together on the
+    # drop-key with one multi-operand lax.sort (TPU sort network), instead
+    # of argsort + per-plane gather (which lowers to scalar loops).
+    active = jnp.arange(S)[None, :] < state.count[:, None]
+    keep = active & ~(state.removed_seq <= min_seq[:, None])
+    key = (~keep).astype(jnp.int32)
+    planes = [sd[k] for k in _PLANES]
+    sorted_ = jax.lax.sort([key] + planes, dimension=1, is_stable=True,
+                           num_keys=1)
+    out = dict(zip(_PLANES, sorted_[1:]))
+    out["count"] = jnp.sum(keep.astype(jnp.int32), axis=1)
+    out["overflow"] = state.overflow
+    return StringState(**out)
 
 
 def string_state_digest(state: StringState) -> jax.Array:
