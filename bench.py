@@ -12,12 +12,13 @@ timed sections end with a device→host read of the per-doc overflow flags —
 the same read a real sequencer ack path would do. Any dispatch whose result
 the host waits on pays a fixed ~100 ms tunnel round-trip (measured and
 reported as ``dispatch_rtt_ms``); a production deployment with a locally
-attached host pays microseconds. Latency metric: ``apply_window_p99_ms`` is
-the p99 over individually-synced 64-op-scan dispatches divided by the 64
-sequential windows each dispatch applies — an upper bound on per-window
-device apply latency (each sample's full tunnel RTT is charged to its 64
-windows). It is NOT the latency of dispatching one 1-op batch from this
-host, which is RTT-floored at ~100 ms by the test tunnel alone.
+attached host pays microseconds. Latency metric: ``apply_window_worst_ms``
+is the WORST of 8 individually-synced 64-op-scan dispatches divided by the
+64 sequential windows each dispatch applies — an upper bound on per-window
+device apply latency, and therefore on its p99 (each sample's full tunnel
+RTT is charged to its 64 windows). It is NOT the latency of dispatching one
+1-op batch from this host, which is RTT-floored at ~100 ms by the test
+tunnel alone.
 
 The workload runs in a child process with up to 3 attempts because the
 experimental axon platform can transiently crash the TPU worker; the parent
@@ -96,9 +97,9 @@ def run():
     # --- latency phase: per-window apply latency -----------------------------
     # The op axis is time-sequential: each step of the 64-op scan is one
     # apply window over all 10k docs. Sample individually-synced dispatches;
-    # p99 over samples / windows-per-dispatch bounds per-window device
-    # latency (see module docstring for exactly what this does and does not
-    # measure).
+    # worst sample / windows-per-dispatch bounds per-window device latency
+    # from above — and hence its p99 (see module docstring for exactly what
+    # this does and does not measure).
     samples = []
     for c in range(8):
         state = StringState.create(n_docs, capacity)
@@ -107,7 +108,7 @@ def run():
         state = apply_fn(state, *batches[c % n_batches])
         _ = np.asarray(state.overflow)
         samples.append(time.perf_counter() - tb)
-    p99_ms = float(np.percentile(samples, 99) * 1000 / ops_per_batch)
+    worst_ms = float(max(samples) * 1000 / ops_per_batch)
 
     print(json.dumps({
         "metric": "sharedstring_ops_per_sec_merged",
@@ -116,7 +117,7 @@ def run():
         "vs_baseline": round(ops_per_sec / 1_000_000, 4),
         "docs": n_docs,
         "total_ops": n_ops,
-        "apply_window_p99_ms": round(p99_ms, 2),
+        "apply_window_worst_ms": round(worst_ms, 2),
         "dispatch_rtt_ms": round(rtt_ms, 1),
         "backend": jax.default_backend(),
     }))

@@ -1,0 +1,72 @@
+"""BASELINE config #1: single-doc typing-trace replay — CPU reference point.
+
+Replays a deterministic multi-client typing trace (interleaved inserts,
+removes, annotates with crossing in-flight ops) through the pure-Python
+oracle stack (`SequenceClient` + `MockSequencer` — the reference-semantics
+spec everything else is tested against). This is the number the TPU
+speedups are quoted against (BASELINE.md: "run config 1 on CPU to establish
+the local reference number"). Reference analog: replaying a shared-text
+trace through `merge-tree` `Client.applyMsg` (SURVEY.md §3.2, §2.18).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import random
+import time
+
+from fluidframework_tpu.models.merge_tree_client import SequenceClient
+from fluidframework_tpu.testing.mocks import MockSequencer
+
+
+def main(n_ops: int = 4000, n_clients: int = 3, seed: int = 0):
+    rng = random.Random(seed)
+    seqr = MockSequencer()
+    clients = [SequenceClient(seqr.allocate_client_id())
+               for _ in range(n_clients)]
+    for c in clients:
+        seqr.connect(c)
+
+    t0 = time.perf_counter()
+    sent = 0
+    for i in range(n_ops):
+        c = clients[rng.randrange(n_clients)]
+        ln = c.get_length()
+        r = rng.random()
+        if r < 0.70 or ln < 4:
+            pos = rng.randint(0, ln)
+            seqr.submit(c, c.insert_text_local(pos, "abcd"[:rng.randint(1, 4)]))
+        elif r < 0.90:
+            start = rng.randint(0, ln - 2)
+            seqr.submit(c, c.remove_range_local(start, start + 2))
+        else:
+            start = rng.randint(0, ln - 2)
+            seqr.submit(c, c.annotate_range_local(start, start + 2,
+                                                  {"b": True}))
+        sent += 1
+        if rng.random() < 0.3:          # let ops cross in flight
+            seqr.process_some(rng.randint(1, 4))
+    seqr.process_all_messages()
+    total = time.perf_counter() - t0
+
+    texts = {c.get_text() for c in clients}
+    assert len(texts) == 1, "replicas diverged"
+    # every submitted op is applied once per replica
+    applied = sent * n_clients
+    print(json.dumps({
+        "metric": "config1_typing_replay_applies_per_sec",
+        "value": round(applied / total, 1),
+        "unit": "op-applies/s",
+        "vs_baseline": None,
+        "ops_sequenced": sent,
+        "replicas": n_clients,
+        "final_len": clients[0].get_length(),
+        "backend": "cpu-oracle",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""BASELINE config #2: SharedMap op storm across 1k containers on device.
+
+A (doc × op) batch of sequenced set/delete/clear ops is merged for 1024
+documents per jit'd call by the batched map kernel (`ops.map_kernel` —
+the "minimum slice" of SURVEY.md §7.3). Timed section ends with a
+device→host read (see `benches/__init__`).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import time
+
+import numpy as np
+
+
+def main(n_docs: int = 1024, n_keys: int = 64, ops_per_batch: int = 64,
+         n_batches: int = 64, seed: int = 0):
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.map_kernel import MapState, apply_map_batch
+    from fluidframework_tpu.ops.schema import OpKind
+
+    rng = np.random.default_rng(seed)
+    D, O = n_docs, ops_per_batch
+    mix = [int(OpKind.MAP_SET)] * 8 + [int(OpKind.MAP_DELETE)] * 2 \
+        + [int(OpKind.MAP_CLEAR)]
+
+    batches = []
+    seq0 = 1
+    for _ in range(n_batches):
+        kind = rng.choice(mix, size=(D, O)).astype(np.int32)
+        a0 = rng.integers(0, n_keys, size=(D, O), dtype=np.int32)
+        a1 = rng.integers(1, 1 << 20, size=(D, O), dtype=np.int32)
+        seq = (seq0 + np.arange(O, dtype=np.int32)[None, :] * D
+               + np.arange(D, dtype=np.int32)[:, None]).astype(np.int32)
+        seq0 += D * O
+        batches.append(tuple(jnp.asarray(x) for x in (kind, a0, a1, seq)))
+
+    f = jax.jit(apply_map_batch, donate_argnums=0)
+    state = MapState.create(D, n_keys)
+    state = f(state, *batches[0])
+    _ = np.asarray(state.present)        # warm + real sync
+
+    state = MapState.create(D, n_keys)
+    _ = np.asarray(state.present)
+    t0 = time.perf_counter()
+    for b in batches:
+        state = f(state, *b)
+    _ = np.asarray(state.present)        # honest end sync
+    total = time.perf_counter() - t0
+
+    n_ops = D * O * n_batches
+    print(json.dumps({
+        "metric": "config2_sharedmap_ops_per_sec",
+        "value": round(n_ops / total, 1),
+        "unit": "ops/s",
+        "vs_baseline": None,
+        "docs": D,
+        "total_ops": n_ops,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

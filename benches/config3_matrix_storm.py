@@ -1,0 +1,68 @@
+"""BASELINE config #3: SharedMatrix 1k×1k concurrent cell-edit storm.
+
+Merges sequenced set-cell batches into the device-resident sorted sparse
+cell table (`ops.matrix_kernel`) — LWW conflict resolution for ~1M cells
+with 64k-op batches, two multi-operand sorts per batch, no scatters.
+Timed section ends with a device→host read (see `benches/__init__`).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import time
+
+import numpy as np
+
+
+def main(rows: int = 1024, cols: int = 1024, ops_per_batch: int = 1 << 16,
+         n_batches: int = 8, seed: int = 0):
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.matrix_kernel import (
+        MatrixCellState, apply_cells_batch_jit,
+    )
+
+    rng = np.random.default_rng(seed)
+    O = ops_per_batch
+    batches = []
+    for b in range(n_batches):
+        key = (rng.integers(0, rows, O) * cols
+               + rng.integers(0, cols, O)).astype(np.int32)
+        seq = (b * O + np.arange(1, O + 1)).astype(np.int32)
+        val = rng.integers(1, 1 << 30, O, dtype=np.int32)
+        batches.append(tuple(jnp.asarray(x) for x in (key, seq, val)))
+
+    f = apply_cells_batch_jit
+    cap = rows * cols + O
+    state = MatrixCellState.create(cap)
+    state = f(state, *batches[0], False)
+    _ = np.asarray(state.count)          # warm + real sync
+
+    state = MatrixCellState.create(cap)
+    _ = np.asarray(state.count)
+    t0 = time.perf_counter()
+    for b in batches:
+        state = f(state, *b, False)
+    count = int(np.asarray(state.count))  # honest end sync
+    total = time.perf_counter() - t0
+    assert not np.asarray(state.overflow).any()
+
+    n_ops = O * n_batches
+    print(json.dumps({
+        "metric": "config3_sharedmatrix_cell_merges_per_sec",
+        "value": round(n_ops / total, 1),
+        "unit": "ops/s",
+        "vs_baseline": None,
+        "grid": f"{rows}x{cols}",
+        "total_ops": n_ops,
+        "live_cells": count,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

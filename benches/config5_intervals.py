@@ -1,0 +1,78 @@
+"""BASELINE config #5 (stretch): rich text + IntervalCollection co-editing.
+
+Simulates many co-editors on one document: interleaved text edits and
+interval add/change/delete through the full `SharedString` DDS (interval
+endpoints are merge-tree local references that slide on concurrent
+removes — the ProseMirror-style workload). The 100k-co-editor scale of the
+original config is reached by document sharding (each doc is independent —
+SURVEY.md §2.14); this measures the per-document interval engine rate, so
+docs/sec at fleet scale = this number × chips ÷ ops-per-doc.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import random
+import time
+
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.testing.mocks import MockSequencer, \
+    create_connected_dds
+
+
+def main(n_replicas: int = 8, n_ops: int = 3000, seed: int = 0):
+    rng = random.Random(seed)
+    seqr = MockSequencer()
+    reps = [create_connected_dds(seqr, SharedString) for _ in range(n_replicas)]
+    ivs = [r.get_interval_collection("comments") for r in reps]
+    live_ids = []
+
+    t0 = time.perf_counter()
+    sent = 0
+    for i in range(n_ops):
+        k = rng.randrange(n_replicas)
+        r = reps[k]
+        ln = r.get_length()
+        p = rng.random()
+        if p < 0.55 or ln < 8:
+            r.insert_text(rng.randint(0, ln), "lorem "[:rng.randint(1, 6)])
+        elif p < 0.70:
+            s = rng.randint(0, ln - 4)
+            r.remove_text(s, s + rng.randint(1, 4))
+        elif p < 0.85:
+            s = rng.randint(0, ln - 6)
+            live_ids.append((k, ivs[k].add(s, s + rng.randint(1, 5),
+                                           {"author": k})))
+        elif p < 0.95 and live_ids:
+            owner, iid = live_ids[rng.randrange(len(live_ids))]
+            s = rng.randint(0, max(0, reps[owner].get_length() - 4))
+            ivs[owner].change(iid, start=s, end=s + 2)
+        elif live_ids:
+            owner, iid = live_ids.pop(rng.randrange(len(live_ids)))
+            ivs[owner].delete(iid)
+        sent += 1
+        if rng.random() < 0.25:
+            seqr.process_some(rng.randint(1, 6))
+    seqr.process_all_messages()
+    total = time.perf_counter() - t0
+
+    assert len({r.get_text() for r in reps}) == 1, "text diverged"
+    assert len({c.digest() for c in ivs}) == 1, "intervals diverged"
+    applied = sent * n_replicas
+    print(json.dumps({
+        "metric": "config5_intervals_applies_per_sec",
+        "value": round(applied / total, 1),
+        "unit": "op-applies/s",
+        "vs_baseline": None,
+        "replicas": n_replicas,
+        "ops_sequenced": sent,
+        "intervals": len(ivs[0]),
+        "backend": "cpu-oracle",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .schema import OpKind
+from .schema import OpKind, ValueInterner
 
 
 @jax.tree_util.register_dataclass
@@ -123,8 +123,7 @@ class TensorMapStore:
         self.n_keys = n_keys
         self.state = MapState.create(n_docs, n_keys)
         self._key_ids: List[Dict[str, int]] = [dict() for _ in range(n_docs)]
-        self._values: List = [None]  # handle 0 = reserved
-        self._value_ids: Dict[str, int] = {}
+        self._interner = ValueInterner()
 
     # ------------------------------------------------------------- interning
 
@@ -137,12 +136,7 @@ class TensorMapStore:
         return ids[key]
 
     def value_handle(self, value) -> int:
-        import json
-        enc = json.dumps(value, sort_keys=True)
-        if enc not in self._value_ids:
-            self._value_ids[enc] = len(self._values)
-            self._values.append(value)
-        return self._value_ids[enc]
+        return self._interner.handle(value)
 
     # ----------------------------------------------------------------- apply
 
@@ -184,7 +178,7 @@ class TensorMapStore:
         out = {}
         for key, slot in self._key_ids[doc].items():
             if present[slot]:
-                out[key] = self._values[value[slot]]
+                out[key] = self._interner.value(value[slot])
         return out
 
     def digests(self) -> np.ndarray:

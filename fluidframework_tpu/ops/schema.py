@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import json
 
 import numpy as np
 
@@ -108,3 +109,23 @@ class OpBatch:
     @staticmethod
     def from_stacked(arr: np.ndarray) -> "OpBatch":
         return OpBatch(*(np.ascontiguousarray(arr[:, i]) for i in range(N_OP_FIELDS)))
+
+
+class ValueInterner:
+    """JSON value ↔ int32 handle interning shared by the device stores
+    (map/matrix): handle 0 is reserved for "no value"; equal values (by
+    canonical JSON encoding) share one handle."""
+
+    def __init__(self):
+        self._values: list = [None]
+        self._ids: dict = {}
+
+    def handle(self, value) -> int:
+        enc = json.dumps(value, sort_keys=True)
+        if enc not in self._ids:
+            self._ids[enc] = len(self._values)
+            self._values.append(value)
+        return self._ids[enc]
+
+    def value(self, handle: int):
+        return self._values[handle]

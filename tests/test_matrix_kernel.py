@@ -1,0 +1,96 @@
+"""Matrix cell kernel vs a plain-dict LWW/FWW oracle.
+
+The kernel's contract (merge a sequenced set-cell stream into the persistent
+cell set under LWW or first-writer-wins policy) is exactly expressible as a
+dict fold, so the oracle is trivial — the interesting part is that the
+sort-based table merge (concat → sort → winner mark → re-sort → truncate)
+reproduces it under every batch split. Reference semantics: SURVEY.md §2.4
+(``SharedMatrix`` LWW cells, ``switchSetCellPolicy``).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops.matrix_kernel import (
+    EMPTY_KEY, MatrixCellState, TensorMatrixStore, apply_cells_batch_jit,
+    matrix_cells_digest,
+)
+
+import jax.numpy as jnp
+
+
+def oracle_merge(records, fww=False):
+    cells = {}
+    for r, c, v, s in records:  # seq ascending
+        if fww and (r, c) in cells:
+            continue
+        cells[(r, c)] = v
+    return cells
+
+
+def storm(seed, n_ops, n_rows=16, n_cols=16):
+    rng = random.Random(seed)
+    return [(rng.randrange(n_rows), rng.randrange(n_cols),
+             f"v{rng.randrange(40)}", s + 1) for s in range(n_ops)]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_lww_matches_oracle_random_batching(seed):
+    recs = storm(seed, 300)
+    store = TensorMatrixStore(capacity=512, batch_size=64)
+    rng = random.Random(seed + 1)
+    i = 0
+    while i < len(recs):
+        step = rng.randint(1, 90)
+        store.apply_batch(recs[i:i + step])
+        i += step
+    assert not store.overflowed()
+    assert store.read_cells() == oracle_merge(recs)
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_fww_matches_oracle(seed):
+    recs = storm(seed, 200, n_rows=6, n_cols=6)
+    store = TensorMatrixStore(capacity=256, batch_size=32)
+    store.switch_set_cell_policy()
+    store.apply_batch(recs)
+    assert store.read_cells() == oracle_merge(recs, fww=True)
+
+
+def test_fww_respects_existing_table_entries():
+    store = TensorMatrixStore(capacity=64, batch_size=8)
+    store.apply_batch([(0, 0, "first", 1)])     # LWW phase
+    store.switch_set_cell_policy()
+    store.apply_batch([(0, 0, "late", 5), (1, 1, "new", 6)])
+    assert store.read_cells() == {(0, 0): "first", (1, 1): "new"}
+
+
+def test_digest_invariant_to_batch_split():
+    recs = storm(5, 256)
+    digs = []
+    for bs in (16, 64, 256):
+        store = TensorMatrixStore(capacity=512, batch_size=bs)
+        store.apply_batch(recs)
+        digs.append(int(matrix_cells_digest(store.state)))
+    assert len(set(digs)) == 1
+
+
+def test_overflow_sticky_flag():
+    state = MatrixCellState.create(4)
+    keys = jnp.asarray(np.arange(8, dtype=np.int32))
+    seqs = jnp.asarray(np.arange(1, 9, dtype=np.int32))
+    vals = jnp.asarray(np.arange(8, dtype=np.int32))
+    state = apply_cells_batch_jit(state, keys, seqs, vals, False)
+    assert int(state.overflow) == 1
+    assert int(state.count) == 4  # clamped
+
+
+def test_empty_pads_are_inert():
+    store = TensorMatrixStore(capacity=32, batch_size=16)
+    store.apply_batch([(2, 3, "x", 1)])  # 15 pad rows ride along
+    store.apply_batch([])                # no-op
+    assert store.read_cells() == {(2, 3): "x"}
+    assert int(store.state.count) == 1
+    assert not store.overflowed()
