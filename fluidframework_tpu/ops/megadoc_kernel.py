@@ -1,0 +1,303 @@
+"""Mega-doc merge: one document's segment axis sharded across the mesh.
+
+This is the framework's sequence/context-parallelism. The reference has no
+tensor axes to shard — its analog of "long context" is MergeTree scaling in
+document length (SURVEY.md §5.7) — so the TPU-native design shards the
+*segment axis* of a very long document across chips, the way ring attention
+shards the sequence axis: each device owns a contiguous run of segment
+slots, and per-op position resolution becomes a distributed prefix sum
+(all-gather of per-shard visible lengths over ICI + local cumsum), after
+which exactly one shard applies an insert locally and every shard marks its
+clipped slice of a remove. Communication per op is two small all-gathers
+((D,) visible totals, then (D, 2) owner flags that depend on the exclusive
+prefix) — bandwidth-trivial, latency-bound on ICI.
+
+Reuses the single-shard roll-based helpers from ``merge_tree_kernel`` (the
+local apply is identical vector math); only position resolution is
+collective. Semantics match the single-device kernel: the content digest of
+a mega-doc equals ``string_state_digest`` of the same ops applied to one
+unsharded state (tested on the virtual 8-device CPU mesh).
+
+Layout: D mega-docs × S_local slots per device, planes sharded
+``P(None, SEG_AXIS)``; ops replicated. The host calls
+``rebalance_megadoc`` preemptively (between batches, while shards still
+have headroom) to spread slots evenly — the distributed zamboni. A shard
+whose slots fill mid-batch sets the per-(doc, shard) sticky overflow flag,
+which means ops were DROPPED: that doc must be drained and rebuilt through
+the oracle (the same escape hatch as the single-device kernel), not
+rebalanced — ``rebalance_megadoc`` refuses overflowed state.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .merge_tree_kernel import (
+    _PLANES, StringState, _insert_one, _remove_one, _state_dict, _visible,
+    compact_string_state,
+)
+from ..core.constants import NOT_REMOVED
+from .schema import OpKind
+
+SEG_AXIS = "seg"
+_SPEC = P(None, SEG_AXIS)
+# Every plane AND count/overflow shard on the segment axis: count/overflow
+# are per-(doc, shard) quantities carried as (D, n_shards) columns globally,
+# so inside shard_map each device sees (D, 1) and squeezes to its own (D,).
+STATE_SPECS = dict({k: _SPEC for k in _PLANES}, count=_SPEC, overflow=_SPEC)
+
+
+def _narrow(sd):
+    """Shard-local (D, 1) count/overflow columns → (D,) vectors."""
+    return dict(sd, count=sd["count"][:, 0], overflow=sd["overflow"][:, 0])
+
+
+def _widen(sd):
+    """(D,) shard-local count/overflow → (D, 1) columns for out_specs."""
+    return dict(sd, count=sd["count"][:, None],
+                overflow=sd["overflow"][:, None])
+
+
+def make_megadoc_mesh(n_devices: int | None = None) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(np.array(devices[:n]).reshape(n), (SEG_AXIS,))
+
+
+def _shard_step(n_shards: int):
+    """Per-shard body: planes (D, S_local) local to this device."""
+
+    def step(sd, op):
+        kind, a0, a1, a2, seq, client, ref_seq = op
+        idx = jax.lax.axis_index(SEG_AXIS)
+
+        def one(s, k, p0, p1, p2, sq, cl, rs):
+            S = s["seq"].shape[0]
+            vis = _visible(s, rs, cl)
+            pl = jnp.where(vis, s["length"], 0)
+            local_vis = jnp.sum(pl)
+
+            # Insert ownership must reproduce the single-device rule (insert
+            # at the leftmost ACTIVE slot whose perspective-prefix >= pos,
+            # counting invisible concurrent segments): the owner is the shard
+            # strictly containing pos inside a visible segment if one exists,
+            # else the FIRST shard holding any such candidate slot — trailing
+            # invisible concurrent inserts at the boundary belong to the
+            # earlier shard, and a later-sequenced insert must land LEFT of
+            # them.
+            active = jnp.arange(S) < s["count"]
+            g_pre = jnp.cumsum(pl) - pl
+            totals = jax.lax.all_gather(local_vis, SEG_AXIS)   # (n_shards,)
+            ex = jnp.sum(jnp.where(jnp.arange(n_shards) < idx, totals, 0))
+            gp = ex + g_pre
+            inside_here = jnp.any(vis & (gp < p0) & (p0 < gp + s["length"]))
+            cand_here = jnp.any(active & (gp >= p0))
+            flags = jax.lax.all_gather(
+                jnp.stack([inside_here.astype(jnp.int32),
+                           cand_here.astype(jnp.int32)]), SEG_AXIS)  # (n, 2)
+            owner = jnp.where(
+                jnp.any(flags[:, 0] > 0), jnp.argmax(flags[:, 0]),
+                jnp.where(jnp.any(flags[:, 1] > 0), jnp.argmax(flags[:, 1]),
+                          n_shards - 1))
+            owns = idx == owner
+            ins = _insert_one(s, p0 - ex, p1, p2, sq, cl, rs)
+            ins = {k2: jnp.where(owns, ins[k2], s[k2]) for k2 in s}
+
+            # ---- remove: every shard marks its clipped overlap
+            l0 = jnp.clip(p0 - ex, 0, local_vis)
+            l1 = jnp.clip(p1 - ex, 0, local_vis)
+            rem = _remove_one(s, l0, l1, sq, cl, rs)
+            rem = {k2: jnp.where(l1 > l0, rem[k2], s[k2]) for k2 in s}
+
+            is_ins = k == OpKind.STR_INSERT
+            is_rem = k == OpKind.STR_REMOVE
+            return {k2: jnp.where(is_ins, ins[k2],
+                                  jnp.where(is_rem, rem[k2], s[k2]))
+                    for k2 in s}
+
+        return jax.vmap(one)(sd, kind, a0, a1, a2, seq, client, ref_seq), None
+
+    return step
+
+
+def _megadoc_apply_local(n_shards, sd, kind, a0, a1, a2, seq, client,
+                         ref_seq):
+    """shard_map body: scan the op axis with collective position resolve."""
+    ops = (kind.T, a0.T, a1.T, a2.T, seq.T, client.T, ref_seq.T)
+    out, _ = jax.lax.scan(_shard_step(n_shards), sd, ops)
+    return out
+
+
+def apply_megadoc_batch(mesh: Mesh, state: StringState, kind, a0, a1, a2,
+                        seq, client, ref_seq) -> StringState:
+    """Apply a dense (D, O) sequenced batch to D seg-sharded mega-docs."""
+    op_spec = P(None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(STATE_SPECS,) + (op_spec,) * 7,
+        out_specs=STATE_SPECS)
+    def run(sd, *ops):
+        return _widen(_megadoc_apply_local(mesh.devices.size, _narrow(sd),
+                                           *ops))
+
+    sd = _state_dict(state)
+    out = run(sd, kind, a0, a1, a2, seq, client, ref_seq)
+    return StringState(**out)
+
+
+def megadoc_digest(mesh: Mesh, state: StringState) -> jax.Array:
+    """Content digest of each mega-doc, equal to ``string_state_digest`` of
+    the same content held unsharded (global visible prefix via collective)."""
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(_SPEC,) * 6,
+        out_specs=P(None))
+    def run(seq, removed, length, h_op, h_off, count):
+        S = seq.shape[1]
+        n = jax.lax.axis_size(SEG_AXIS)
+        idx = jax.lax.axis_index(SEG_AXIS)
+        active = jnp.arange(S)[None, :] < count[:, :1]
+        live = active & (removed == NOT_REMOVED)
+        pl = jnp.where(live, length, 0)
+        local_tot = jnp.sum(pl, axis=1)                        # (D,)
+        totals = jax.lax.all_gather(local_tot, SEG_AXIS, axis=1)  # (D, n)
+        ex = jnp.sum(jnp.where(jnp.arange(n)[None, :] < idx, totals, 0),
+                     axis=1)                                   # (D,)
+        pre = jnp.cumsum(pl, axis=1) - pl + ex[:, None]
+        mix = (h_op * 1000003 + (h_off - pre) * 8191) * pl
+        part = jnp.sum(jnp.where(live, mix, 0), axis=1) + local_tot
+        return jax.lax.psum(part, SEG_AXIS)
+
+    return run(state.seq, state.removed_seq, state.length, state.handle_op,
+               state.handle_off, state.count)
+
+
+def compact_megadoc(mesh: Mesh, state: StringState, min_seq) -> StringState:
+    """Distributed zamboni: each shard compacts its own slot run locally.
+
+    Tombstones acked at or below min_seq (D,) are dropped shard-locally with
+    the same stable-partition sort as ``compact_string_state`` — no
+    communication needed, since slot ownership never crosses shards; only
+    the host rebalancer (overflow path) moves segments between shards."""
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(STATE_SPECS, P(None)), out_specs=STATE_SPECS)
+    def run(sd, ms):
+        local = StringState(**_narrow(sd))
+        return _widen(_state_dict(compact_string_state(local, ms)))
+
+    out = run(_state_dict(state), jnp.asarray(min_seq, jnp.int32))
+    return StringState(**out)
+
+
+def rebalance_megadoc(mesh: Mesh, state: StringState) -> StringState:
+    """Host-side PREEMPTIVE shard rebalance (call while shards have headroom).
+
+    A fresh mega-doc concentrates content on whichever shard owns the
+    insert positions (initially the last), so shards fill unevenly. This
+    pulls the planes to host, concatenates each doc's shard-local active
+    runs in shard order (= global document order), deals the slots back out
+    evenly across shards, and re-uploads with the same shardings.
+    Tombstones move with their neighbours: they still govern visibility for
+    ops whose ref_seq predates the removal.
+
+    Raises on sticky overflow: a set flag means ops were already dropped
+    and the doc's content is unrecoverable from device state — it must be
+    drained and rebuilt through the oracle instead (rebalancing would
+    silently erase the only evidence of the loss)."""
+    if np.asarray(state.overflow).any():
+        raise ValueError(
+            "mega-doc state has sticky overflow: ops were dropped; drain "
+            "the affected docs through the oracle and rebuild — rebalance "
+            "cannot recover them")
+    n = mesh.devices.size
+    S_local = state.seq.shape[1] // n
+    planes = {k: np.asarray(getattr(state, k)) for k in _PLANES}
+    count = np.asarray(state.count)                       # (D, n)
+    D = count.shape[0]
+    new = {k: np.zeros_like(planes[k]) for k in _PLANES}
+    new["removed_seq"][:] = NOT_REMOVED
+    new_count = np.zeros((D, n), np.int32)
+    for d in range(D):
+        cat = {k: np.concatenate([
+            planes[k][d, s * S_local: s * S_local + count[d, s]]
+            for s in range(n)]) for k in _PLANES}
+        tot = len(cat["seq"])
+        base, extra = divmod(tot, n)
+        off = 0
+        for s in range(n):
+            c = base + (1 if s < extra else 0)
+            if c > S_local:
+                raise ValueError(f"doc {d}: {tot} live slots exceed "
+                                 f"mesh capacity {n * S_local}")
+            for k in _PLANES:
+                new[k][d, s * S_local: s * S_local + c] = cat[k][off:off + c]
+            new_count[d, s] = c
+            off += c
+    arrays = dict(new, count=new_count,
+                  overflow=np.zeros((D, n), np.int32))
+    return StringState(**{
+        k: jax.device_put(jnp.asarray(arrays[k]),
+                          NamedSharding(mesh, STATE_SPECS[k]))
+        for k in STATE_SPECS
+    })
+
+
+def create_megadoc_state(mesh: Mesh, n_docs: int,
+                         capacity_per_shard: int) -> StringState:
+    """(D, n_shards * S_local) planes with count/overflow per (doc, shard)."""
+    n = mesh.devices.size
+    st = StringState.create(n_docs, n * capacity_per_shard)
+    wide = StringState(
+        seq=st.seq, client=st.client, removed_seq=st.removed_seq,
+        removers=st.removers, length=st.length, handle_op=st.handle_op,
+        handle_off=st.handle_off,
+        count=jnp.zeros((n_docs, n), jnp.int32),
+        overflow=jnp.zeros((n_docs, n), jnp.int32),
+    )
+    return StringState(**{
+        k: jax.device_put(getattr(wide, k),
+                          NamedSharding(mesh, STATE_SPECS[k]))
+        for k in STATE_SPECS
+    })
+
+
+def visible_runs(state: StringState):
+    """Host-side order-SENSITIVE content oracle: per doc, the
+    (handle_op, handle_off, length) runs of live segments in document order,
+    adjacent pieces of the same insert coalesced so the result is invariant
+    to physical split history. Accepts both layouts: single-device state
+    (count shape (D,)) and mega-doc state (count shape (D, n_shards), slots
+    shard-major). Unlike the additive digest this detects reordered content."""
+    count = np.asarray(state.count)
+    n_shards = 1 if count.ndim == 1 else count.shape[1]
+    count = count.reshape(count.shape[0], n_shards)
+    planes = {k: np.asarray(getattr(state, k)) for k in
+              ("removed_seq", "handle_op", "handle_off", "length")}
+    D = count.shape[0]
+    S_local = planes["length"].shape[1] // n_shards
+    docs = []
+    for d in range(D):
+        runs = []
+        for s in range(n_shards):
+            lo = s * S_local
+            for i in range(lo, lo + count[d, s]):
+                if planes["removed_seq"][d, i] != NOT_REMOVED:
+                    continue
+                op = int(planes["handle_op"][d, i])
+                off = int(planes["handle_off"][d, i])
+                ln = int(planes["length"][d, i])
+                if runs and runs[-1][0] == op and \
+                        runs[-1][1] + runs[-1][2] == off:
+                    runs[-1] = (op, runs[-1][1], runs[-1][2] + ln)
+                else:
+                    runs.append((op, off, ln))
+        docs.append(runs)
+    return docs

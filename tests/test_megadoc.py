@@ -1,0 +1,207 @@
+"""Mega-doc (segment-axis-sharded) kernel: parity with the single-device
+merge-tree kernel on the virtual 8-device CPU mesh.
+
+The mega-doc path is this framework's sequence/context parallelism
+(SURVEY.md §5.7): one very long document's segment slots are sharded across
+the mesh, position resolution is a distributed prefix sum over ICI, and the
+content digest must equal ``string_state_digest`` of the same op stream
+applied unsharded.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from fluidframework_tpu.ops.megadoc_kernel import (
+    apply_megadoc_batch, compact_megadoc, create_megadoc_state,
+    make_megadoc_mesh, megadoc_digest, rebalance_megadoc, visible_runs,
+)
+from fluidframework_tpu.ops.merge_tree_kernel import (
+    StringState, apply_string_batch, string_state_digest,
+)
+from fluidframework_tpu.testing.synthetic import typing_storm
+
+ORDER = ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")
+
+
+def _ops(n_docs, n_ops, seed=0, start_seq=1):
+    planes, next_seq = typing_storm(n_docs, n_ops, seed=seed,
+                                    start_seq=start_seq)
+    return tuple(jnp.asarray(planes[k]) for k in ORDER), next_seq
+
+
+def test_megadoc_matches_single_device():
+    mesh = make_megadoc_mesh(8)
+    n_docs, n_ops, cap_per_shard = 3, 24, 64
+    ops, _ = _ops(n_docs, n_ops)
+
+    single = apply_string_batch(
+        StringState.create(n_docs, 8 * cap_per_shard), *ops)
+    ref_digest = np.asarray(string_state_digest(single))
+
+    state = create_megadoc_state(mesh, n_docs, cap_per_shard)
+    state = apply_megadoc_batch(mesh, state, *ops)
+    assert not np.asarray(state.overflow).any()
+    assert np.array_equal(np.asarray(megadoc_digest(mesh, state)), ref_digest)
+    # order-sensitive oracle: the additive digest is blind to reordered runs
+    assert visible_runs(state) == visible_runs(single)
+
+
+def test_megadoc_multiple_rounds_threads_state():
+    mesh = make_megadoc_mesh(8)
+    n_docs, n_ops, cap_per_shard = 2, 12, 64
+    state = create_megadoc_state(mesh, n_docs, cap_per_shard)
+    ref = StringState.create(n_docs, 8 * cap_per_shard)
+    seq = 1
+    for r in range(3):
+        ops, seq = _ops(n_docs, n_ops, seed=r, start_seq=seq)
+        state = apply_megadoc_batch(mesh, state, *ops)
+        ref = apply_string_batch(ref, *ops)
+        assert np.array_equal(np.asarray(megadoc_digest(mesh, state)),
+                              np.asarray(string_state_digest(ref))), r
+        assert visible_runs(state) == visible_runs(ref), r
+
+
+def test_megadoc_compaction_preserves_digest_and_frees_slots():
+    mesh = make_megadoc_mesh(8)
+    n_docs, n_ops, cap_per_shard = 2, 32, 64
+    ops, next_seq = _ops(n_docs, n_ops)
+    state = apply_megadoc_batch(
+        mesh, create_megadoc_state(mesh, n_docs, cap_per_shard), *ops)
+    before = np.asarray(megadoc_digest(mesh, state))
+    used_before = np.asarray(state.count).sum()
+    min_seq = np.full((n_docs,), next_seq - 1, np.int32)  # window closed
+    state = compact_megadoc(mesh, state, min_seq)
+    assert np.array_equal(np.asarray(megadoc_digest(mesh, state)), before)
+    assert np.asarray(state.count).sum() <= used_before
+    # digest must stay correct after post-compaction ops (stale slots beyond
+    # count left by the compaction sort must not leak into the digest)
+    ops2, _ = _ops(n_docs, 8, seed=9, start_seq=next_seq)
+    state = apply_megadoc_batch(mesh, state, *ops2)
+    ref = apply_string_batch(StringState.create(n_docs, 8 * cap_per_shard),
+                             *_ops(n_docs, n_ops)[0])
+    from fluidframework_tpu.ops.merge_tree_kernel import compact_string_state
+    ref = compact_string_state(ref, jnp.asarray(min_seq))
+    ref = apply_string_batch(ref, *ops2)
+    assert np.array_equal(np.asarray(megadoc_digest(mesh, state)),
+                          np.asarray(string_state_digest(ref)))
+    assert visible_runs(state) == visible_runs(ref)
+
+
+def test_megadoc_rebalance_spreads_load_and_preserves_parity():
+    """Small shards survive a long stream via rebalance between rounds."""
+    mesh = make_megadoc_mesh(8)
+    n_docs, cap_per_shard = 2, 16
+    state = create_megadoc_state(mesh, n_docs, cap_per_shard)
+    ref = StringState.create(n_docs, 8 * cap_per_shard)
+    seq = 1
+    for r in range(5):
+        ops, seq = _ops(n_docs, 6, seed=r, start_seq=seq)
+        state = apply_megadoc_batch(mesh, state, *ops)
+        ref = apply_string_batch(ref, *ops)
+        assert not np.asarray(state.overflow).any(), r
+        state = rebalance_megadoc(mesh, state)
+        counts = np.asarray(state.count)
+        spread = counts.max(axis=1) - counts.min(axis=1)
+        assert (spread <= 1).all()  # dealt evenly within each doc
+        assert np.array_equal(np.asarray(megadoc_digest(mesh, state)),
+                              np.asarray(string_state_digest(ref))), r
+        assert visible_runs(state) == visible_runs(ref), r
+
+
+def test_megadoc_overflow_flag_not_corruption():
+    mesh = make_megadoc_mesh(8)
+    n_docs, cap_per_shard = 1, 4  # absurdly small shards
+    ops, _ = _ops(n_docs, 64)
+    state = apply_megadoc_batch(
+        mesh, create_megadoc_state(mesh, n_docs, cap_per_shard), *ops)
+    counts = np.asarray(state.count)
+    assert np.asarray(state.overflow).any()  # flagged, not crashed
+    assert (counts <= cap_per_shard).all()
+
+
+def _planes_from_msgs(msgs, n_ops_pad=None):
+    """Convert oracle-sequenced merge-tree messages to (1, O) op planes with
+    host-side client/payload interning (mirrors TensorStringStore)."""
+    from fluidframework_tpu.ops.schema import OpKind
+    recs, clients, payloads = [], {}, [None]
+    for m in msgs:
+        op = m.contents
+        cl = clients.setdefault(m.client_id, len(clients))
+        if op["mt"] == "insert":
+            if op["kind"] == 1:
+                payloads.append(("marker", ""))
+                recs.append((int(OpKind.STR_INSERT), op["pos"], 1,
+                             len(payloads) - 1, m.seq, cl, m.ref_seq))
+            elif op["text"]:
+                payloads.append(("text", op["text"]))
+                recs.append((int(OpKind.STR_INSERT), op["pos"],
+                             len(op["text"]), len(payloads) - 1, m.seq, cl,
+                             m.ref_seq))
+        elif op["mt"] == "remove":
+            recs.append((int(OpKind.STR_REMOVE), op["start"], op["end"], 0,
+                         m.seq, cl, m.ref_seq))
+    o = n_ops_pad or len(recs)
+    planes = np.zeros((7, 1, o), np.int32)
+    planes[0, :, :] = int(OpKind.NOOP)
+    for j, r in enumerate(recs):
+        planes[:, 0, j] = r
+    return tuple(jnp.asarray(planes[i]) for i in range(7))
+
+
+def test_megadoc_multiclient_fuzz_matches_single_device():
+    """Real multi-client streams (lagging ref_seq → invisible concurrent
+    segments) must resolve insert ownership identically to the unsharded
+    kernel — the case single-client storms cannot exercise."""
+    from tests.test_merge_tree_kernel import collab_stream
+    mesh = make_megadoc_mesh(8)
+    for seed in range(6):
+        _, _, msgs = collab_stream(seed, n_rounds=10)
+        ops = _planes_from_msgs(msgs)
+        single = apply_string_batch(StringState.create(1, 1024), *ops)
+        state = create_megadoc_state(mesh, 1, 128)
+        state = apply_megadoc_batch(mesh, state, *ops)
+        assert not np.asarray(state.overflow).any(), seed
+        assert visible_runs(state) == visible_runs(single), seed
+
+
+def test_megadoc_boundary_insert_orders_before_invisible_concurrent():
+    """Regression: a later-sequenced insert at a shard boundary must land
+    LEFT of an earlier concurrent insert held by the earlier shard, even
+    when that shard's perspective-visible length is zero."""
+    from fluidframework_tpu.ops.schema import OpKind
+    mesh = make_megadoc_mesh(8)
+    I, R = int(OpKind.STR_INSERT), int(OpKind.STR_REMOVE)
+    # seq1: client 0 inserts Y(len 2, handle 10) at 0
+    # seq2: client 1 removes [0,2) (ref 1)          -> Y tombstoned
+    # seq3: client 2 inserts E(len 3, handle 11) at 0 (ref 1: still sees Y)
+    # seq4: client 3 inserts L(len 4, handle 12) at 0 (ref 2: sees removal,
+    #        NOT E) -> must land before E (leftmost rule)
+    recs = [(I, 0, 2, 10, 1, 0, 0), (R, 0, 2, 0, 2, 1, 1),
+            (I, 0, 3, 11, 3, 2, 1), (I, 0, 4, 12, 4, 3, 2)]
+    planes = np.zeros((7, 1, 4), np.int32)
+    for j, r in enumerate(recs):
+        planes[:, 0, j] = r
+    ops = tuple(jnp.asarray(planes[i]) for i in range(7))
+    single = apply_string_batch(StringState.create(1, 64), *ops)
+
+    state = create_megadoc_state(mesh, 1, 8)
+    # seed Y onto shard 0 the way a rebalance would place it
+    state = apply_megadoc_batch(mesh, state, *(p[:, :1] for p in ops))
+    state = rebalance_megadoc(mesh, state)
+    assert np.asarray(state.count)[0, 0] == 1  # Y lives on shard 0
+    state = apply_megadoc_batch(mesh, state, *(p[:, 1:] for p in ops))
+    runs = visible_runs(state)
+    assert runs == visible_runs(single)
+    assert [r[0] for r in runs[0]] == [12, 11]  # L before E
+
+
+def test_megadoc_rebalance_refuses_overflowed_state():
+    """Overflow means ops were dropped; rebalance must not erase the flag."""
+    import pytest
+    mesh = make_megadoc_mesh(8)
+    ops, _ = _ops(1, 64)
+    state = apply_megadoc_batch(
+        mesh, create_megadoc_state(mesh, 1, 4), *ops)
+    assert np.asarray(state.overflow).any()
+    with pytest.raises(ValueError, match="overflow"):
+        rebalance_megadoc(mesh, state)
