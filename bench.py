@@ -55,8 +55,16 @@ def run():
                                    start_seq=seq)
         batches.append(tuple(jnp.asarray(planes[k]) for k in order))
 
-    apply_fn = jax.jit(apply_string_batch, donate_argnums=0)
-    compact_fn = jax.jit(compact_string_state, donate_argnums=0)
+    # no-props mode: the typing corpus carries no annotates, so the store
+    # runs the annotate-free kernel variant (the mode a production store is
+    # in until its first annotate; see TensorStringStore._has_props)
+    import functools
+    apply_fn = jax.jit(
+        functools.partial(apply_string_batch, with_props=False),
+        donate_argnums=0)
+    compact_fn = jax.jit(
+        functools.partial(compact_string_state, with_props=False),
+        donate_argnums=0)
 
     # warmup / compile on a throwaway state
     state = StringState.create(n_docs, capacity)

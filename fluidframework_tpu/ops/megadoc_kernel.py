@@ -38,7 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .merge_tree_kernel import (
-    _PLANES, StringState, _insert_one, _remove_one, _state_dict, _visible,
+    _PLANES, StringState, _insert_one, _range_one, _state_dict, _visible,
     compact_string_state,
 )
 from ..core.constants import NOT_REMOVED
@@ -49,7 +49,8 @@ _SPEC = P(None, SEG_AXIS)
 # Every plane AND count/overflow shard on the segment axis: count/overflow
 # are per-(doc, shard) quantities carried as (D, n_shards) columns globally,
 # so inside shard_map each device sees (D, 1) and squeezes to its own (D,).
-STATE_SPECS = dict({k: _SPEC for k in _PLANES}, count=_SPEC, overflow=_SPEC)
+STATE_SPECS = dict({k: _SPEC for k in _PLANES}, count=_SPEC, overflow=_SPEC,
+                   prop_val=P(None, SEG_AXIS, None))
 
 
 def _narrow(sd):
@@ -108,16 +109,16 @@ def _shard_step(n_shards: int):
             ins = _insert_one(s, p0 - ex, p1, p2, sq, cl, rs)
             ins = {k2: jnp.where(owns, ins[k2], s[k2]) for k2 in s}
 
-            # ---- remove: every shard marks its clipped overlap
+            # ---- remove/annotate: every shard marks its clipped overlap
             l0 = jnp.clip(p0 - ex, 0, local_vis)
             l1 = jnp.clip(p1 - ex, 0, local_vis)
-            rem = _remove_one(s, l0, l1, sq, cl, rs)
-            rem = {k2: jnp.where(l1 > l0, rem[k2], s[k2]) for k2 in s}
+            rng = _range_one(s, k, l0, l1, p2, sq, cl, rs)
+            rng = {k2: jnp.where(l1 > l0, rng[k2], s[k2]) for k2 in s}
 
             is_ins = k == OpKind.STR_INSERT
-            is_rem = k == OpKind.STR_REMOVE
+            is_rng = (k == OpKind.STR_REMOVE) | (k == OpKind.STR_ANNOTATE)
             return {k2: jnp.where(is_ins, ins[k2],
-                                  jnp.where(is_rem, rem[k2], s[k2]))
+                                  jnp.where(is_rng, rng[k2], s[k2]))
                     for k2 in s}
 
         return jax.vmap(one)(sd, kind, a0, a1, a2, seq, client, ref_seq), None
@@ -219,16 +220,17 @@ def rebalance_megadoc(mesh: Mesh, state: StringState) -> StringState:
             "cannot recover them")
     n = mesh.devices.size
     S_local = state.seq.shape[1] // n
-    planes = {k: np.asarray(getattr(state, k)) for k in _PLANES}
+    keys = _PLANES + ("prop_val",)
+    planes = {k: np.asarray(getattr(state, k)) for k in keys}
     count = np.asarray(state.count)                       # (D, n)
     D = count.shape[0]
-    new = {k: np.zeros_like(planes[k]) for k in _PLANES}
+    new = {k: np.zeros_like(planes[k]) for k in keys}
     new["removed_seq"][:] = NOT_REMOVED
     new_count = np.zeros((D, n), np.int32)
     for d in range(D):
         cat = {k: np.concatenate([
             planes[k][d, s * S_local: s * S_local + count[d, s]]
-            for s in range(n)]) for k in _PLANES}
+            for s in range(n)]) for k in keys}
         tot = len(cat["seq"])
         base, extra = divmod(tot, n)
         off = 0
@@ -237,7 +239,7 @@ def rebalance_megadoc(mesh: Mesh, state: StringState) -> StringState:
             if c > S_local:
                 raise ValueError(f"doc {d}: {tot} live slots exceed "
                                  f"mesh capacity {n * S_local}")
-            for k in _PLANES:
+            for k in keys:
                 new[k][d, s * S_local: s * S_local + c] = cat[k][off:off + c]
             new_count[d, s] = c
             off += c
@@ -258,7 +260,7 @@ def create_megadoc_state(mesh: Mesh, n_docs: int,
     wide = StringState(
         seq=st.seq, client=st.client, removed_seq=st.removed_seq,
         removers=st.removers, length=st.length, handle_op=st.handle_op,
-        handle_off=st.handle_off,
+        handle_off=st.handle_off, prop_val=st.prop_val,
         count=jnp.zeros((n_docs, n), jnp.int32),
         overflow=jnp.zeros((n_docs, n), jnp.int32),
     )
@@ -271,16 +273,18 @@ def create_megadoc_state(mesh: Mesh, n_docs: int,
 
 def visible_runs(state: StringState):
     """Host-side order-SENSITIVE content oracle: per doc, the
-    (handle_op, handle_off, length) runs of live segments in document order,
-    adjacent pieces of the same insert coalesced so the result is invariant
-    to physical split history. Accepts both layouts: single-device state
-    (count shape (D,)) and mega-doc state (count shape (D, n_shards), slots
-    shard-major). Unlike the additive digest this detects reordered content."""
+    (handle_op, handle_off, length, props) runs of live segments in document
+    order, adjacent pieces of the same insert with identical properties
+    coalesced so the result is invariant to physical split history. Accepts
+    both layouts: single-device state (count shape (D,)) and mega-doc state
+    (count shape (D, n_shards), slots shard-major). Unlike the additive
+    digest this detects reordered content and lost/misplaced annotations."""
     count = np.asarray(state.count)
     n_shards = 1 if count.ndim == 1 else count.shape[1]
     count = count.reshape(count.shape[0], n_shards)
     planes = {k: np.asarray(getattr(state, k)) for k in
               ("removed_seq", "handle_op", "handle_off", "length")}
+    props = np.asarray(state.prop_val)
     D = count.shape[0]
     S_local = planes["length"].shape[1] // n_shards
     docs = []
@@ -294,10 +298,12 @@ def visible_runs(state: StringState):
                 op = int(planes["handle_op"][d, i])
                 off = int(planes["handle_off"][d, i])
                 ln = int(planes["length"][d, i])
+                pv = tuple(int(x) for x in props[d, i])
                 if runs and runs[-1][0] == op and \
-                        runs[-1][1] + runs[-1][2] == off:
-                    runs[-1] = (op, runs[-1][1], runs[-1][2] + ln)
+                        runs[-1][1] + runs[-1][2] == off and \
+                        runs[-1][3] == pv:
+                    runs[-1] = (op, runs[-1][1], runs[-1][2] + ln, pv)
                 else:
-                    runs.append((op, off, ln))
+                    runs.append((op, off, ln, pv))
         docs.append(runs)
     return docs

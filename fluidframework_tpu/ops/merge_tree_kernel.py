@@ -68,15 +68,20 @@ class StringState:
     length: jax.Array       # (D, S) int32 run length
     handle_op: jax.Array    # (D, S) int32 payload table id
     handle_off: jax.Array   # (D, S) int32 offset within the payload
+    prop_val: jax.Array     # (D, S, K) int32 value handle per property key
     count: jax.Array        # (D,)  int32 active slot count
     overflow: jax.Array     # (D,)  int32 sticky overflow flag
 
     @staticmethod
-    def create(n_docs: int, capacity: int) -> "StringState":
+    def create(n_docs: int, capacity: int, n_props: int = 4) -> "StringState":
+        """n_props: K property-key planes for annotate (per-key LWW). Keys
+        are host-interned to plane indexes; a store needing more distinct
+        keys than K must be created wider (static shape)."""
         z = lambda fill=0: jnp.full((n_docs, capacity), fill, dtype=jnp.int32)
         return StringState(
             seq=z(), client=z(), removed_seq=z(NOT_REMOVED), removers=z(),
             length=z(), handle_op=z(), handle_off=z(),
+            prop_val=jnp.zeros((n_docs, capacity, n_props), jnp.int32),
             count=jnp.zeros((n_docs,), jnp.int32),
             overflow=jnp.zeros((n_docs,), jnp.int32),
         )
@@ -109,7 +114,8 @@ _PLANES = ("seq", "client", "removed_seq", "removers", "length",
            "handle_op", "handle_off")
 
 
-def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq):
+def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq,
+                with_props=True):
     """Apply one insert to one doc (S-vector planes in dict s).
 
     Gather-free: the result is ``s`` below the cut slot, ``roll(s, 1)``
@@ -161,14 +167,29 @@ def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq):
     out["removed_seq"] = jnp.where(is_new, NOT_REMOVED, out["removed_seq"])
     out["removers"] = jnp.where(is_new, 0, out["removers"])
 
+    # property planes (S, K): same roll, split right piece inherits the
+    # containing slot's props via roll-by-2; new segments carry none (host
+    # inserts-with-props are expressed as insert + annotate at one seq).
+    # with_props=False (host knows no annotate ever touched this store):
+    # all-zero planes are permutation-invariant, skip the movement — this
+    # is ~35% of the kernel's HBM traffic.
+    if with_props:
+        pshift = jnp.where(has_inside, jnp.roll(s["prop_val"], 2, axis=0),
+                           jnp.roll(s["prop_val"], 1, axis=0))
+        pv = jnp.where(below[:, None], s["prop_val"], pshift)
+        out["prop_val"] = jnp.where(is_new[:, None], 0, pv)
+    else:
+        out["prop_val"] = s["prop_val"]
+
     # overflow: leave the doc untouched, set the sticky flag
-    res = {k: jnp.where(would_overflow, s[k], out[k]) for k in _PLANES}
+    res = {k: jnp.where(would_overflow, s[k], out[k])
+           for k in _PLANES + ("prop_val",)}
     res["count"] = jnp.where(would_overflow, s["count"], new_count)
     res["overflow"] = jnp.where(would_overflow, 1, s["overflow"])
     return res
 
 
-def _split_at(s, p, ref_seq, client_idx):
+def _split_at(s, p, ref_seq, client_idx, with_props=True):
     """Split the visible segment strictly containing perspective position p."""
     S = s["seq"].shape[0]
     i = jnp.arange(S)
@@ -194,39 +215,59 @@ def _split_at(s, p, ref_seq, client_idx):
         jnp.where(is_right, out["length"] - off, out["length"]))
     out["handle_off"] = jnp.where(
         is_right, out["handle_off"] + off, out["handle_off"])
+    out["prop_val"] = jnp.where((i <= j)[:, None], s["prop_val"],
+                                jnp.roll(s["prop_val"], 1, axis=0)) \
+        if with_props else s["prop_val"]
 
-    res = {k: jnp.where(do, out[k], s[k]) for k in _PLANES}
+    res = {k: jnp.where(do, out[k], s[k]) for k in _PLANES + ("prop_val",)}
     res["count"] = jnp.where(do, new_count, s["count"])
     res["overflow"] = jnp.where(has_inside & would_overflow, 1, s["overflow"])
     return res
 
 
-def _remove_one(s, start, end_pos, seq, client_idx, ref_seq):
-    """Mark [start, end) removed in the op's perspective (two splits + mark).
+PROP_HANDLE_BITS = 20  # a2 for annotate = key plane index << 20 | value handle
 
-    Only segments visible to the remover are marked — concurrently inserted
-    text inside the range survives, overlapping removes keep the earliest
-    acked removal seq and accumulate remover bits (reference semantics)."""
-    s = _split_at(s, start, ref_seq, client_idx)
-    s = _split_at(s, end_pos, ref_seq, client_idx)
+
+def _range_one(s, kind, start, end_pos, packed, seq, client_idx, ref_seq,
+               with_props=True):
+    """Apply one remove OR annotate to one doc — both are "two splits at the
+    perspective boundaries + mark the visible segments strictly inside", so
+    they share the expensive split passes and differ only in the cheap mark.
+
+    Remove: only segments visible to the remover are marked — concurrently
+    inserted text inside the range survives, overlapping removes keep the
+    earliest acked removal seq and accumulate remover bits.
+
+    Annotate: per-key last-sequenced-writer-wins (reference: merge-tree
+    annotate). ``packed`` = key plane index << PROP_HANDLE_BITS | value
+    handle; handle 0 deletes the key. Scan order is seq order, so a plain
+    overwrite of the key's plane on visible targets realises LWW."""
+    s = _split_at(s, start, ref_seq, client_idx, with_props)
+    s = _split_at(s, end_pos, ref_seq, client_idx, with_props)
     vis = _visible(s, ref_seq, client_idx)
     pre, endp = _prefix(s, vis)
     target = vis & (pre >= start) & (endp <= end_pos) & (s["length"] > 0)
+
+    is_rem = kind == OpKind.STR_REMOVE
     bit = jnp.where(client_idx >= 0,
                     (1 << jnp.clip(client_idx, 0, MAX_CLIENTS - 1)), 0)
     out = dict(s)
     out["removed_seq"] = jnp.where(
-        target, jnp.minimum(s["removed_seq"], seq), s["removed_seq"])
-    out["removers"] = jnp.where(target, s["removers"] | bit, s["removers"])
+        target & is_rem, jnp.minimum(s["removed_seq"], seq),
+        s["removed_seq"])
+    out["removers"] = jnp.where(target & is_rem, s["removers"] | bit,
+                                s["removers"])
+
+    if with_props:
+        K = s["prop_val"].shape[1]
+        key_idx = packed >> PROP_HANDLE_BITS
+        handle = packed & ((1 << PROP_HANDLE_BITS) - 1)
+        sel = (target & (kind == OpKind.STR_ANNOTATE))[:, None] & \
+            (jnp.arange(K)[None, :] == key_idx)
+        out["prop_val"] = jnp.where(sel, handle, s["prop_val"])
     return out
 
 
-def _annotate_one(s, start, end_pos, seq, client_idx, ref_seq):
-    """Annotate ranges device-side v1: split boundaries so the host can apply
-    properties to exact slots; property planes land in a later revision."""
-    s = _split_at(s, start, ref_seq, client_idx)
-    s = _split_at(s, end_pos, ref_seq, client_idx)
-    return s
 
 
 # ------------------------------------------------------------- batched apply
@@ -236,35 +277,43 @@ def _state_dict(state: StringState):
         "seq": state.seq, "client": state.client,
         "removed_seq": state.removed_seq, "removers": state.removers,
         "length": state.length, "handle_op": state.handle_op,
-        "handle_off": state.handle_off, "count": state.count,
-        "overflow": state.overflow,
+        "handle_off": state.handle_off, "prop_val": state.prop_val,
+        "count": state.count, "overflow": state.overflow,
     }
 
 
 def apply_string_batch(state: StringState, kind, a0, a1, a2, seq, client,
-                       ref_seq) -> StringState:
+                       ref_seq, with_props: bool = True) -> StringState:
     """Apply a dense (D, O) batch of sequenced merge-tree ops.
 
     kind/a0/a1/a2/seq/client/ref_seq: (D, O) int32 planes. Per doc, ops apply
     in ascending op index (the sequencer's total order); NOOP pads.
     STR_INSERT: a0=pos, a1=len, a2=payload handle. STR_REMOVE: a0=start,
-    a1=end.
+    a1=end. STR_ANNOTATE: a0=start, a1=end, a2=key plane << 20 | value
+    handle.
+
+    with_props=False (static): the host guarantees no annotate has ever
+    touched this state, so the all-zero property planes are permutation-
+    invariant and all prop movement is skipped (the planes thread through
+    the scan untouched).
     """
     sd = _state_dict(state)
 
     def step(carry, op):
         k, p0, p1, p2, sq, cl, rs = op
 
-        ins = jax.vmap(_insert_one)(carry, p0, p1, p2, sq, cl, rs)
-        rem = jax.vmap(_remove_one)(carry, p0, p1, sq, cl, rs)
+        ins = jax.vmap(functools.partial(_insert_one, with_props=with_props)
+                       )(carry, p0, p1, p2, sq, cl, rs)
+        rng = jax.vmap(functools.partial(_range_one, with_props=with_props)
+                       )(carry, k, p0, p1, p2, sq, cl, rs)
 
         def pick(key):
-            is_ins = (k == OpKind.STR_INSERT)[:, None] \
-                if carry[key].ndim == 2 else (k == OpKind.STR_INSERT)
-            is_rem = (k == OpKind.STR_REMOVE)[:, None] \
-                if carry[key].ndim == 2 else (k == OpKind.STR_REMOVE)
+            tail = (1,) * (carry[key].ndim - 1)
+            is_ins = (k == OpKind.STR_INSERT).reshape((-1,) + tail)
+            is_rng = ((k == OpKind.STR_REMOVE) |
+                      (k == OpKind.STR_ANNOTATE)).reshape((-1,) + tail)
             return jnp.where(is_ins, ins[key],
-                             jnp.where(is_rem, rem[key], carry[key]))
+                             jnp.where(is_rng, rng[key], carry[key]))
 
         return {key: pick(key) for key in carry}, None
 
@@ -273,10 +322,12 @@ def apply_string_batch(state: StringState, kind, a0, a1, a2, seq, client,
     return StringState(**out)
 
 
-apply_string_batch_jit = jax.jit(apply_string_batch, donate_argnums=0)
+apply_string_batch_jit = jax.jit(apply_string_batch, donate_argnums=0,
+                                 static_argnames=("with_props",))
 
 
-def compact_string_state(state: StringState, min_seq) -> StringState:
+def compact_string_state(state: StringState, min_seq,
+                         with_props: bool = True) -> StringState:
     """Zamboni on device: drop tombstones whose removal is acked at or below
     minSeq (reference: merge-tree zamboni; SURVEY.md §7.4 "compaction kernel
     keyed on MSN"). Stable partition keeps document order. min_seq: (D,)."""
@@ -289,10 +340,14 @@ def compact_string_state(state: StringState, min_seq) -> StringState:
     active = jnp.arange(S)[None, :] < state.count[:, None]
     keep = active & ~(state.removed_seq <= min_seq[:, None])
     key = (~keep).astype(jnp.int32)
-    planes = [sd[k] for k in _PLANES]
+    K = state.prop_val.shape[2] if with_props else 0
+    planes = [sd[k] for k in _PLANES] + \
+        [state.prop_val[:, :, i] for i in range(K)]
     sorted_ = jax.lax.sort([key] + planes, dimension=1, is_stable=True,
                            num_keys=1)
-    out = dict(zip(_PLANES, sorted_[1:]))
+    out = dict(zip(_PLANES, sorted_[1:1 + len(_PLANES)]))
+    out["prop_val"] = jnp.stack(sorted_[1 + len(_PLANES):], axis=2) \
+        if with_props else state.prop_val  # all-zero: permutation-invariant
     out["count"] = jnp.sum(keep.astype(jnp.int32), axis=1)
     out["overflow"] = state.overflow
     return StringState(**out)

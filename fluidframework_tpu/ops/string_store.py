@@ -18,22 +18,31 @@ import numpy as np
 
 from ..core.constants import NOT_REMOVED
 from .merge_tree_kernel import (
-    MAX_CLIENTS, StringState, apply_string_batch_jit, compact_string_state,
-    string_state_digest,
+    MAX_CLIENTS, PROP_HANDLE_BITS, StringState, apply_string_batch_jit,
+    compact_string_state, string_state_digest,
 )
-from .schema import OpKind
+from .schema import OpKind, ValueInterner
 
 _TEXT = 0
 _MARKER = 1
 
 
 class TensorStringStore:
-    def __init__(self, n_docs: int, capacity: int = 256):
+    def __init__(self, n_docs: int, capacity: int = 256, n_props: int = 4):
         self.n_docs = n_docs
         self.capacity = capacity
-        self.state = StringState.create(n_docs, capacity)
+        self.n_props = n_props
+        self.state = StringState.create(n_docs, capacity, n_props)
         self._payloads: List[Tuple[int, str]] = [(_TEXT, "")]  # handle 0
         self._client_idx: List[Dict[int, int]] = [dict() for _ in range(n_docs)]
+        # annotate: property KEYS intern to plane indexes (store-wide),
+        # VALUES intern to handles; handle 0 = key unset (None deletes).
+        # Until the first annotate arrives the kernels run in the no-props
+        # mode (all-zero planes are permutation-invariant; skipping their
+        # movement saves ~35% HBM traffic on the hot path).
+        self._prop_planes: Dict[str, int] = {}
+        self._prop_values = ValueInterner()
+        self._has_props = False
 
     # ------------------------------------------------------------- interning
 
@@ -48,6 +57,23 @@ class TensorStringStore:
     def _payload(self, kind: int, text: str) -> int:
         self._payloads.append((kind, text))
         return len(self._payloads) - 1
+
+    def _prop_plane(self, key: str) -> int:
+        if key not in self._prop_planes:
+            if len(self._prop_planes) >= self.n_props:
+                raise KeyError(
+                    f"property key capacity {self.n_props} exhausted "
+                    f"(recreate the store with a larger n_props)")
+            self._prop_planes[key] = len(self._prop_planes)
+        return self._prop_planes[key]
+
+    def _prop_handle(self, value) -> int:
+        if value is None:
+            return 0
+        h = self._prop_values.handle(value)
+        if h >= (1 << PROP_HANDLE_BITS):
+            raise OverflowError("property value table exceeded 2^20 entries")
+        return h
 
     # ----------------------------------------------------------------- apply
 
@@ -69,11 +95,36 @@ class TensorStringStore:
                     length = len(op["text"])
                 rec = (int(OpKind.STR_INSERT), op["pos"], length, handle,
                        msg.seq, cl, msg.ref_seq)
+                if op.get("props"):
+                    # insert-with-props = insert + same-seq annotate of the
+                    # new segment: in the op's own perspective the inserted
+                    # run occupies exactly [pos, pos+len), and nothing else
+                    # visible moved, so the annotate targets only it
+                    per_doc.setdefault(doc, []).append(rec)
+                    self._has_props = True
+                    for key in sorted(op["props"]):
+                        packed = (self._prop_plane(key)
+                                  << PROP_HANDLE_BITS) | \
+                            self._prop_handle(op["props"][key])
+                        per_doc[doc].append(
+                            (int(OpKind.STR_ANNOTATE), op["pos"],
+                             op["pos"] + length, packed, msg.seq, cl,
+                             msg.ref_seq))
+                    continue
             elif op["mt"] == "remove":
                 rec = (int(OpKind.STR_REMOVE), op["start"], op["end"], 0,
                        msg.seq, cl, msg.ref_seq)
             elif op["mt"] == "annotate":
-                continue  # properties are host-side in v1
+                # one device record per property key (the kernel's per-key
+                # LWW planes); all records share the message's seq
+                self._has_props = True
+                for key in sorted(op["props"]):
+                    packed = (self._prop_plane(key) << PROP_HANDLE_BITS) | \
+                        self._prop_handle(op["props"][key])
+                    per_doc.setdefault(doc, []).append(
+                        (int(OpKind.STR_ANNOTATE), op["start"], op["end"],
+                         packed, msg.seq, cl, msg.ref_seq))
+                continue
             else:
                 raise ValueError(f"unknown op {op['mt']!r}")
             per_doc.setdefault(doc, []).append(rec)
@@ -105,13 +156,14 @@ class TensorStringStore:
         self.state = apply_string_batch_jit(
             self.state, *(jnp.asarray(planes[k]) for k in
                           ("kind", "a0", "a1", "a2", "seq", "client",
-                           "ref_seq")))
+                           "ref_seq")),
+            with_props=self._has_props)
 
     def compact(self, min_seq) -> None:
         """Zamboni: free tombstones below the collaboration window."""
         ms = jnp.full((self.n_docs,), int(min_seq), jnp.int32) \
             if np.isscalar(min_seq) else jnp.asarray(min_seq, jnp.int32)
-        self.state = compact_string_state(self.state, ms)
+        self.state = compact_string_state(self.state, ms, self._has_props)
 
     # ----------------------------------------------------------------- reads
 
@@ -137,6 +189,25 @@ class TensorStringStore:
         rem = np.asarray(st.removed_seq[doc][:n])
         length = np.asarray(st.length[doc][:n])
         return int(length[rem == NOT_REMOVED].sum())
+
+    def get_properties(self, doc: int, pos: int) -> dict:
+        """Properties of the character at visible position pos (reference:
+        ``SharedString.getPropertiesAtPosition``)."""
+        st = self.state
+        n = int(st.count[doc])
+        rem = np.asarray(st.removed_seq[doc][:n])
+        length = np.asarray(st.length[doc][:n])
+        pv = np.asarray(st.prop_val[doc][:n])
+        at = 0
+        for i in range(n):
+            if rem[i] != NOT_REMOVED:
+                continue
+            if at <= pos < at + length[i]:
+                return {key: self._prop_values.value(int(pv[i, plane]))
+                        for key, plane in self._prop_planes.items()
+                        if pv[i, plane] != 0}
+            at += length[i]
+        raise IndexError(f"doc {doc}: position {pos} beyond length {at}")
 
     def overflowed(self) -> np.ndarray:
         return np.asarray(self.state.overflow)

@@ -41,7 +41,8 @@ def _state_specs() -> StringState:
     return StringState(
         seq=STATE_SPEC, client=STATE_SPEC, removed_seq=STATE_SPEC,
         removers=STATE_SPEC, length=STATE_SPEC, handle_op=STATE_SPEC,
-        handle_off=STATE_SPEC, count=COUNT_SPEC, overflow=COUNT_SPEC,
+        handle_off=STATE_SPEC, prop_val=P(DOC_AXIS, None, None),
+        count=COUNT_SPEC, overflow=COUNT_SPEC,
     )
 
 

@@ -121,9 +121,11 @@ def test_megadoc_overflow_flag_not_corruption():
 
 def _planes_from_msgs(msgs, n_ops_pad=None):
     """Convert oracle-sequenced merge-tree messages to (1, O) op planes with
-    host-side client/payload interning (mirrors TensorStringStore)."""
+    host-side client/payload/property interning (mirrors TensorStringStore)."""
+    from fluidframework_tpu.ops.merge_tree_kernel import PROP_HANDLE_BITS
     from fluidframework_tpu.ops.schema import OpKind
     recs, clients, payloads = [], {}, [None]
+    prop_planes, prop_vals = {}, {}
     for m in msgs:
         op = m.contents
         cl = clients.setdefault(m.client_id, len(clients))
@@ -140,6 +142,15 @@ def _planes_from_msgs(msgs, n_ops_pad=None):
         elif op["mt"] == "remove":
             recs.append((int(OpKind.STR_REMOVE), op["start"], op["end"], 0,
                          m.seq, cl, m.ref_seq))
+        elif op["mt"] == "annotate":
+            for key in sorted(op["props"]):
+                plane = prop_planes.setdefault(key, len(prop_planes))
+                v = op["props"][key]
+                h = 0 if v is None else prop_vals.setdefault(repr(v),
+                                                             len(prop_vals) + 1)
+                recs.append((int(OpKind.STR_ANNOTATE), op["start"],
+                             op["end"], (plane << PROP_HANDLE_BITS) | h,
+                             m.seq, cl, m.ref_seq))
     o = n_ops_pad or len(recs)
     planes = np.zeros((7, 1, o), np.int32)
     planes[0, :, :] = int(OpKind.NOOP)
@@ -155,7 +166,7 @@ def test_megadoc_multiclient_fuzz_matches_single_device():
     from tests.test_merge_tree_kernel import collab_stream
     mesh = make_megadoc_mesh(8)
     for seed in range(6):
-        _, _, msgs = collab_stream(seed, n_rounds=10)
+        _, _, msgs = collab_stream(seed, n_rounds=10, with_annotates=True)
         ops = _planes_from_msgs(msgs)
         single = apply_string_batch(StringState.create(1, 1024), *ops)
         state = create_megadoc_state(mesh, 1, 128)

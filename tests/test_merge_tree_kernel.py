@@ -18,8 +18,13 @@ from fluidframework_tpu.testing.fuzz import _rand_text
 from fluidframework_tpu.testing.mocks import MockSequencer
 
 
+_PROP_KEYS = ("bold", "italic", "color")
+_PROP_VALUES = (True, 1, "red", "blue", None)  # None deletes the key
+
+
 def collab_stream(seed, n_clients=3, n_rounds=20, ops_per_round=4,
-                  with_markers=True):
+                  with_markers=True, with_annotates=False,
+                  return_clients=False):
     """Run an oracle collab session; return (converged text, sequenced msgs)."""
     rng = random.Random(seed)
     seqr = MockSequencer()
@@ -42,10 +47,23 @@ def collab_stream(seed, n_clients=3, n_rounds=20, ops_per_round=4,
             c = rng.choice(clients)
             n = c.get_length()
             roll = rng.random()
-            if n == 0 or roll < 0.55:
-                op = c.insert_text_local(rng.randint(0, n), _rand_text(rng))
-            elif roll < 0.62 and with_markers:
-                op = c.insert_marker_local(rng.randint(0, n))
+            if n == 0 or roll < 0.5:
+                props = {k: rng.choice(_PROP_VALUES[:-1])
+                         for k in rng.sample(_PROP_KEYS, rng.randint(0, 2))} \
+                    if with_annotates and rng.random() < 0.3 else None
+                op = c.insert_text_local(rng.randint(0, n), _rand_text(rng),
+                                         props)
+            elif roll < 0.57 and with_markers:
+                props = {"markerId": rng.randint(1, 9)} \
+                    if with_annotates and rng.random() < 0.5 else None
+                op = c.insert_marker_local(rng.randint(0, n), props)
+            elif roll < 0.75 and with_annotates:
+                start = rng.randint(0, n - 1)
+                props = {k: rng.choice(_PROP_VALUES)
+                         for k in rng.sample(_PROP_KEYS,
+                                             rng.randint(1, len(_PROP_KEYS)))}
+                op = c.annotate_range_local(
+                    start, rng.randint(start + 1, min(n, start + 8)), props)
             else:
                 start = rng.randint(0, n - 1)
                 op = c.remove_range_local(
@@ -55,7 +73,8 @@ def collab_stream(seed, n_clients=3, n_rounds=20, ops_per_round=4,
     seqr.process_all_messages()
     texts = {c.get_text() for c in clients}
     assert len(texts) == 1
-    return texts.pop(), clients[0].get_length(), msgs
+    out = (texts.pop(), clients[0].get_length(), msgs)
+    return out + (clients,) if return_clients else out
 
 
 @pytest.mark.parametrize("seed", range(12))
@@ -135,3 +154,34 @@ def test_kernel_digest_split_invariance():
         s2.apply_messages([(0, m)])
     assert s1.read_text(0) == s2.read_text(0) == text
     assert np.array_equal(s1.digests(), s2.digests())
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_kernel_annotate_matches_oracle(seed):
+    """Per-key LWW annotate on device: every visible position's property set
+    must match the converged oracle replica (incl. None-deletes, concurrent
+    annotates crossing removes/inserts, and split inheritance)."""
+    text, length, msgs, clients = collab_stream(
+        seed, with_annotates=True, return_clients=True)
+    store = TensorStringStore(n_docs=1, capacity=512)
+    store.apply_messages((0, m) for m in msgs)
+    assert store.read_text(0) == text
+    oracle = clients[0]
+    for pos in range(length):
+        seg, _ = oracle.tree.get_containing_segment(pos)
+        want = {k: v for k, v in seg.props.items() if v is not None}
+        assert store.get_properties(0, pos) == want, f"pos {pos}"
+
+
+def test_kernel_annotate_survives_compaction():
+    text, length, msgs, clients = collab_stream(
+        11, with_annotates=True, return_clients=True, n_rounds=25)
+    store = TensorStringStore(n_docs=1, capacity=1024)
+    store.apply_messages((0, m) for m in msgs)
+    store.compact(max(m.seq for m in msgs))
+    assert store.read_text(0) == text
+    oracle = clients[0]
+    for pos in range(length):
+        seg, _ = oracle.tree.get_containing_segment(pos)
+        want = {k: v for k, v in seg.props.items() if v is not None}
+        assert store.get_properties(0, pos) == want, f"pos {pos}"
