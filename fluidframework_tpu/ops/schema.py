@@ -129,3 +129,14 @@ class ValueInterner:
 
     def value(self, handle: int):
         return self._values[handle]
+
+    def export(self) -> list:
+        """Values in handle order (element 0 is the reserved None)."""
+        return list(self._values)
+
+    @classmethod
+    def restore(cls, values: list) -> "ValueInterner":
+        it = cls()
+        for v in values[1:]:
+            it.handle(v)
+        return it

@@ -11,6 +11,7 @@ bitmask.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -217,3 +218,63 @@ class TensorStringStore:
 
     def digests(self) -> np.ndarray:
         return np.asarray(string_state_digest(self.state))
+
+    # ----------------------------------------------------- snapshot / resume
+
+    # every per-slot plane of StringState, derived so a future plane cannot
+    # be silently dropped from snapshots
+    _SNAP_PLANES = tuple(
+        f.name for f in dataclasses.fields(StringState)
+        if f.name not in ("count", "overflow"))
+
+    def snapshot(self) -> dict:
+        """Device→host gather of the merged state plus the host interning
+        tables (reference: channel ``summarize()``; SURVEY.md §7.7 — the
+        Summarizer reuses the same kernels: resume = ``restore`` + tail
+        replay through ``apply_messages``). Compact first for a minimal
+        snapshot. Planes are trimmed to the widest doc's slot count."""
+        st = self.state
+        counts = np.asarray(st.count)
+        n = max(int(counts.max()), 1)
+        return {
+            "planes": {k: np.asarray(getattr(st, k))[:, :n].copy()
+                       for k in self._SNAP_PLANES},
+            "count": counts.copy(),
+            "overflow": np.asarray(st.overflow).copy(),
+            "capacity": self.capacity,
+            "n_props": self.n_props,
+            "payloads": list(self._payloads),
+            "client_idx": [dict(m) for m in self._client_idx],
+            "prop_planes": dict(self._prop_planes),
+            "prop_values": self._prop_values.export(),
+            "has_props": self._has_props,
+        }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "TensorStringStore":
+        """Rebuild a store from ``snapshot()`` output: planes are padded
+        back to capacity and re-uploaded; merging resumes mid-stream.
+        Skips __init__'s device allocation (the snapshot fully replaces it)."""
+        n_docs = snap["count"].shape[0]
+        store = cls.__new__(cls)
+        store.n_docs = n_docs
+        store.capacity = snap["capacity"]
+        store.n_props = snap["n_props"]
+        cap = snap["capacity"]
+        full = {}
+        for k in cls._SNAP_PLANES:
+            small = np.asarray(snap["planes"][k])
+            shape = (n_docs, cap) + small.shape[2:]
+            fill = NOT_REMOVED if k == "removed_seq" else 0
+            plane = np.full(shape, fill, np.int32)
+            plane[:, :small.shape[1]] = small
+            full[k] = jnp.asarray(plane)
+        store.state = StringState(
+            **full, count=jnp.asarray(snap["count"]),
+            overflow=jnp.asarray(snap["overflow"]))
+        store._payloads = [tuple(p) for p in snap["payloads"]]
+        store._client_idx = [dict(m) for m in snap["client_idx"]]
+        store._prop_planes = dict(snap["prop_planes"])
+        store._prop_values = ValueInterner.restore(snap["prop_values"])
+        store._has_props = snap["has_props"]
+        return store

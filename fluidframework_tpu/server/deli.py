@@ -162,3 +162,23 @@ class DeliSequencer:
 
     def doc_seq(self, doc_id: str) -> int:
         return self._doc(doc_id).seq
+
+    def replay(self, msg: SequencedDocumentMessage) -> None:
+        """Re-apply an already-sequenced message to sequencer state (log
+        tail replay after restoring an older checkpoint): the restored
+        counters must advance past every sequenced-but-uncheckpointed op or
+        the resumed partition would re-issue their sequence numbers."""
+        doc = self._doc(msg.doc_id)
+        if msg.type == MessageType.CLIENT_JOIN:
+            doc.clients[msg.client_id] = _ClientState(ref_seq=msg.ref_seq)
+        elif msg.type == MessageType.CLIENT_LEAVE:
+            doc.clients.pop(msg.client_id, None)
+        else:
+            client = doc.clients.get(msg.client_id)
+            if client is not None:
+                if msg.type != MessageType.NOOP:
+                    client.last_client_seq = max(client.last_client_seq,
+                                                 msg.client_seq)
+                client.ref_seq = max(client.ref_seq, msg.ref_seq)
+        doc.seq = max(doc.seq, msg.seq)
+        doc.min_seq = max(doc.min_seq, msg.min_seq)

@@ -1,0 +1,191 @@
+"""The replica serving engine: the end-to-end north-star slice as a service.
+
+Reference counterpart: the full Routerlicious pipeline around the op-merge
+hot path (SURVEY.md §3.2, §3.5) — Alfred ingress → Deli sequencing → Kafka →
+Broadcaster fan-out / Scriptorium persistence, with client containers doing
+the merging. Here the merge itself is the batched device kernel, so the
+service *is* the replica: raw client ops are stamped by ``DeliSequencer``,
+appended to the durable ``PartitionedLog`` (the Kafka role), queued into an
+adaptive batch window, and merged for every resident document at once by
+``TensorStringStore`` (one ``pjit``'d apply per flush). The sequenced
+message returned from ``submit`` is the broadcast/ack.
+
+Recovery is the reference's single primitive (SURVEY.md §5.4): a summary —
+device→host gather of the compacted planes plus sequencer checkpoint and
+log offsets — and a tail replay of the log through the SAME apply kernels.
+
+Batching vs latency (SURVEY.md §7 risk (c)): ops queue until ``batch_window``
+records are waiting, then flush in one device dispatch; ``flush()`` can be
+called any time (reads force it). Smaller windows trade throughput for op
+latency exactly like the reference's outbox flush policy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..ops.string_store import TensorStringStore
+from .deli import DeliSequencer, Nack
+from .oplog import PartitionedLog, partition_of
+
+
+class StringServingEngine:
+    """Sequencer + durable log + batched device merge for many documents."""
+
+    def __init__(self, n_docs: int, capacity: int = 256, n_props: int = 4,
+                 batch_window: int = 64, n_partitions: int = 8,
+                 compact_every: int = 16,
+                 log: Optional[PartitionedLog] = None,
+                 store: Optional[TensorStringStore] = None):
+        self.deli = DeliSequencer()
+        self.log = log if log is not None else PartitionedLog(n_partitions)
+        self.store = store if store is not None \
+            else TensorStringStore(n_docs, capacity, n_props)
+        self.n_docs = n_docs
+        self.batch_window = batch_window
+        self.compact_every = compact_every
+        self._doc_rows: Dict[str, int] = {}
+        self._queue: List[Tuple[int, SequencedDocumentMessage]] = []
+        self._flushes_since_compact = 0
+        self._min_seq: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ membership
+
+    def doc_row(self, doc_id: str) -> int:
+        if doc_id not in self._doc_rows:
+            if len(self._doc_rows) >= self.n_docs:
+                raise KeyError(f"document capacity {self.n_docs} exhausted")
+            self._doc_rows[doc_id] = len(self._doc_rows)
+        return self._doc_rows[doc_id]
+
+    def connect(self, doc_id: str, client_id: int
+                ) -> SequencedDocumentMessage:
+        self.doc_row(doc_id)
+        msg = self.deli.client_join(doc_id, client_id)
+        self._log_append(doc_id, msg)
+        return msg
+
+    def disconnect(self, doc_id: str, client_id: int
+                   ) -> Optional[SequencedDocumentMessage]:
+        msg = self.deli.client_leave(doc_id, client_id)
+        if msg is not None:
+            self._log_append(doc_id, msg)
+        return msg
+
+    # --------------------------------------------------------------- ingress
+
+    def submit(self, doc_id: str, client_id: int, client_seq: int,
+               ref_seq: int, contents: Any
+               ) -> Tuple[Optional[SequencedDocumentMessage], Optional[Nack]]:
+        """Ingest one raw merge-tree op (the ``mt`` dicts of SequenceClient).
+        Returns (sequenced message, None) — the broadcast/ack — or
+        (None, nack)."""
+        msg, nack = self.deli.sequence(
+            doc_id, client_id, client_seq, ref_seq, MessageType.OP, contents)
+        if nack is not None:
+            return None, nack
+        self._log_append(doc_id, msg)
+        self._queue.append((self.doc_row(doc_id), msg))
+        self._min_seq[doc_id] = msg.min_seq
+        if len(self._queue) >= self.batch_window:
+            self.flush()
+        return msg, None
+
+    def heartbeat(self, doc_id: str, client_id: int, ref_seq: int) -> None:
+        """NOOP: advances the client's refSeq (and the doc's MSN) so zamboni
+        can reclaim tombstones; consumes no clientSeq."""
+        msg, _ = self.deli.sequence(
+            doc_id, client_id, 0, ref_seq, MessageType.NOOP, None)
+        if msg is not None:
+            self._min_seq[doc_id] = msg.min_seq
+
+    def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
+        self.log.append(partition_of(doc_id, self.log.n_partitions), msg)
+
+    # ----------------------------------------------------------- device side
+
+    def flush(self) -> int:
+        """Merge the queued window on device in one batched apply."""
+        if not self._queue:
+            return 0
+        n = len(self._queue)
+        self.store.apply_messages(self._queue)
+        self._queue.clear()
+        self._flushes_since_compact += 1
+        if self._flushes_since_compact >= self.compact_every:
+            self.compact()
+        return n
+
+    def compact(self) -> None:
+        """Zamboni at each doc's MSN (collaboration-window floor)."""
+        min_seq = np.zeros((self.n_docs,), np.int32)
+        for doc_id, row in self._doc_rows.items():
+            min_seq[row] = self._min_seq.get(doc_id, 0)
+        self.store.compact(min_seq)
+        self._flushes_since_compact = 0
+
+    # ----------------------------------------------------------------- reads
+
+    def read_text(self, doc_id: str) -> str:
+        self.flush()
+        return self.store.read_text(self._doc_rows[doc_id])
+
+    def get_properties(self, doc_id: str, pos: int) -> dict:
+        self.flush()
+        return self.store.get_properties(self._doc_rows[doc_id], pos)
+
+    def overflowed_docs(self) -> List[str]:
+        """Docs whose device capacity overflowed (ops dropped): these must
+        be drained through the oracle and re-uploaded (the escape hatch of
+        SURVEY.md §7 risk (b))."""
+        flags = self.store.overflowed()
+        return [d for d, row in self._doc_rows.items() if flags[row]]
+
+    # ----------------------------------------------------- summary / recovery
+
+    def summarize(self) -> dict:
+        """Flush + compact, then capture the recovery summary: store
+        snapshot, sequencer checkpoint, per-partition log offsets, doc map."""
+        self.flush()
+        self.compact()
+        return {
+            "store": self.store.snapshot(),
+            "deli": self.deli.checkpoint(),
+            "log_offsets": [self.log.size(p)
+                            for p in range(self.log.n_partitions)],
+            "doc_rows": dict(self._doc_rows),
+            "min_seq": dict(self._min_seq),
+        }
+
+    @classmethod
+    def load(cls, summary: dict, log: PartitionedLog,
+             **kwargs) -> "StringServingEngine":
+        """Resume from a summary + the durable log: restore the device
+        state, restore the sequencer, then replay the log tail (everything
+        appended after the summary's offsets) through the same apply
+        kernels — the single recovery primitive."""
+        store = TensorStringStore.restore(summary["store"])
+        engine = cls(store.n_docs, store.capacity, store.n_props,
+                     log=log, store=store, **kwargs)
+        engine.deli = DeliSequencer.restore(summary["deli"])
+        engine._doc_rows = dict(summary["doc_rows"])
+        engine._min_seq = dict(summary["min_seq"])
+        # replay EVERY tail message through the sequencer state (so resumed
+        # sequencing continues past the tail, not from the stale checkpoint);
+        # JOINs register doc rows (a join-only doc must survive recovery),
+        # OPs queue for the device merge
+        for p in range(log.n_partitions):
+            for msg in log.read(p, from_offset=summary["log_offsets"][p]):
+                engine.deli.replay(msg)
+                if msg.type == MessageType.CLIENT_JOIN:
+                    engine.doc_row(msg.doc_id)
+                elif msg.type == MessageType.OP:
+                    engine._queue.append(
+                        (engine.doc_row(msg.doc_id), msg))
+                    engine._min_seq[msg.doc_id] = msg.min_seq
+        engine._queue.sort(key=lambda dm: dm[1].seq)
+        engine.flush()
+        return engine
