@@ -57,11 +57,20 @@ def run():
 
     # no-props mode: the typing corpus carries no annotates, so the store
     # runs the annotate-free kernel variant (the mode a production store is
-    # in until its first annotate; see TensorStringStore._has_props)
+    # in until its first annotate; see TensorStringStore._has_props).
+    # On TPU the Pallas VMEM-resident kernel applies the whole 64-op batch
+    # with one HBM round-trip of the state (~2.2x the XLA scan); elsewhere
+    # (CPU mesh runs) fall back to the XLA path.
     import functools
-    apply_fn = jax.jit(
-        functools.partial(apply_string_batch, with_props=False),
-        donate_argnums=0)
+    if jax.devices()[0].platform == "tpu":
+        from fluidframework_tpu.ops.pallas_string_kernel import (
+            apply_string_batch_pallas,
+        )
+        apply_fn = jax.jit(apply_string_batch_pallas, donate_argnums=0)
+    else:
+        apply_fn = jax.jit(
+            functools.partial(apply_string_batch, with_props=False),
+            donate_argnums=0)
     compact_fn = jax.jit(
         functools.partial(compact_string_state, with_props=False),
         donate_argnums=0)

@@ -91,8 +91,15 @@ class StringState:
 # All helpers below operate on ONE document (S-vectors) and are vmapped over
 # the doc axis by the batch step.
 
+def _iota(n):
+    """(n,) int32 index vector built from a 2-D iota: usable both as a plain
+    XLA constant and inside Pallas kernels (Mosaic rejects 1-D iota, and
+    pallas_call rejects captured trace-time constants like jnp.arange)."""
+    return jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)[0]
+
+
 def _active(s, S):
-    return jnp.arange(S) < s["count"]
+    return _iota(S) < s["count"]
 
 
 def _visible(s, ref_seq, client_idx):
@@ -104,9 +111,22 @@ def _visible(s, ref_seq, client_idx):
     return _active(s, S) & ins & ~rem
 
 
+def _cumsum(x):
+    """Hillis-Steele inclusive prefix sum along the last axis via static
+    shifts. Equivalent to ``jnp.cumsum`` but built from roll/where/add so it
+    also lowers inside Pallas kernels (Mosaic has no cumsum primitive)."""
+    S = x.shape[-1]
+    idx = _iota(S)
+    step = 1
+    while step < S:
+        x = x + jnp.where(idx >= step, jnp.roll(x, step, axis=-1), 0)
+        step *= 2
+    return x
+
+
 def _prefix(s, vis):
     pl = jnp.where(vis, s["length"], 0)
-    cum = jnp.cumsum(pl)
+    cum = _cumsum(pl)
     return cum - pl, cum - pl + pl  # (exclusive prefix, inclusive end)
 
 
@@ -126,17 +146,21 @@ def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq,
     slots that are overwritten or beyond ``count``.
     """
     S = s["seq"].shape[0]
-    i = jnp.arange(S)
+    i = _iota(S)
     vis = _visible(s, ref_seq, client_idx)
     pre, end = _prefix(s, vis)
 
     inside = vis & (pre < pos) & (pos < end)
     has_inside = jnp.any(inside)
-    j = jnp.argmax(inside)                      # containing slot (split case)
+    # first-true index (min over masked iota): Mosaic lowers min-reductions
+    # but not argmax; S when absent, and every use is has_inside-guarded
+    j = jnp.min(jnp.where(inside, i, S))        # containing slot (split case)
     off = pos - jnp.sum(jnp.where(inside, pre, 0))   # pre[j], one-hot sum
 
     bcand = _active(s, S) & (pre >= pos)
-    idx_b = jnp.where(jnp.any(bcand), jnp.argmax(bcand), s["count"])
+    # active slots have index < count, so the min picks the first candidate
+    # when one exists and falls back to count (append) otherwise
+    idx_b = jnp.min(jnp.where(bcand, i, s["count"]))
 
     shift = jnp.where(has_inside, 2, 1).astype(jnp.int32)
     new_count = s["count"] + shift
@@ -192,12 +216,12 @@ def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq,
 def _split_at(s, p, ref_seq, client_idx, with_props=True):
     """Split the visible segment strictly containing perspective position p."""
     S = s["seq"].shape[0]
-    i = jnp.arange(S)
+    i = _iota(S)
     vis = _visible(s, ref_seq, client_idx)
     pre, end = _prefix(s, vis)
     inside = vis & (pre < p) & (p < end)
     has_inside = jnp.any(inside)
-    j = jnp.argmax(inside)
+    j = jnp.min(jnp.where(inside, i, S))             # first-true index
     off = p - jnp.sum(jnp.where(inside, pre, 0))     # pre[j], one-hot sum
 
     new_count = s["count"] + 1

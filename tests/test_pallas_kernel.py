@@ -1,0 +1,70 @@
+"""Pallas VMEM-resident merge kernel vs the XLA scan path (interpret mode on
+the CPU mesh; the real-TPU run is covered by bench.py and the driver)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from fluidframework_tpu.ops.merge_tree_kernel import (
+    StringState, apply_string_batch,
+)
+from fluidframework_tpu.ops.pallas_string_kernel import (
+    apply_string_batch_pallas,
+)
+from fluidframework_tpu.testing.synthetic import typing_storm
+
+ORDER = ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")
+CHECK = ("seq", "client", "removed_seq", "removers", "length", "handle_op",
+         "handle_off", "count", "overflow")
+
+
+def _assert_equal(a: StringState, b: StringState):
+    for k in CHECK:
+        assert np.array_equal(np.asarray(getattr(a, k)),
+                              np.asarray(getattr(b, k))), k
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pallas_matches_xla_single_batch(seed):
+    planes, _ = typing_storm(16, 32, seed=seed)
+    ops = tuple(jnp.asarray(planes[k]) for k in ORDER)
+    ref = apply_string_batch(StringState.create(16, 256), *ops)
+    out = apply_string_batch_pallas(StringState.create(16, 256), *ops,
+                                    tile=8, interpret=True)
+    _assert_equal(ref, out)
+
+
+def test_pallas_matches_xla_multiclient_stream():
+    """Real multi-client concurrency (lagging ref_seq) through the Pallas
+    op loop."""
+    from tests.test_megadoc import _planes_from_msgs
+    from tests.test_merge_tree_kernel import collab_stream
+    _, _, msgs = collab_stream(4, n_rounds=12)
+    ops = _planes_from_msgs(msgs)
+    ref = apply_string_batch(StringState.create(1, 512), *ops)
+    out = apply_string_batch_pallas(StringState.create(1, 512), *ops,
+                                    tile=1, interpret=True)
+    _assert_equal(ref, out)
+
+
+def test_pallas_threads_state_across_batches():
+    state_p = StringState.create(8, 128)
+    state_x = StringState.create(8, 128)
+    seq = 1
+    for r in range(3):
+        planes, seq = typing_storm(8, 16, seed=r, start_seq=seq)
+        ops = tuple(jnp.asarray(planes[k]) for k in ORDER)
+        state_p = apply_string_batch_pallas(state_p, *ops, tile=8,
+                                            interpret=True)
+        state_x = apply_string_batch(state_x, *ops)
+        _assert_equal(state_x, state_p)
+
+
+def test_pallas_overflow_flag_not_corruption():
+    planes, _ = typing_storm(8, 64, seed=5)
+    ops = tuple(jnp.asarray(planes[k]) for k in ORDER)
+    ref = apply_string_batch(StringState.create(8, 16), *ops)
+    out = apply_string_batch_pallas(StringState.create(8, 16), *ops,
+                                    tile=8, interpret=True)
+    _assert_equal(ref, out)
+    assert np.asarray(out.overflow).any()
