@@ -28,7 +28,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .merge_tree_kernel import (
-    _PLANES, StringState, _insert_one, _range_one,
+    _PLANES, StringState, _cumsum, _insert_one, _range_one,
 )
 from .schema import OpKind
 
@@ -36,7 +36,67 @@ _OPS = 7      # kind, a0, a1, a2, seq, client, ref_seq
 _NP = len(_PLANES)
 
 
-def _kernel(*refs):
+def _compact(c, min_seq):
+    """In-VMEM zamboni: stable stream compaction by bit-decomposed shifts.
+
+    Drop slots whose removal is acked at or below min_seq. Each surviving
+    slot must move left by d = (dropped slots before it) — non-decreasing
+    in slot index, and any two kept slots with displacement difference δ
+    are at least δ+1 apart, so shifting every slot whose d has bit b by
+    2^b (LSB→MSB) never collides. log2(S) roll+select passes, no sort, no
+    gather. Vacated slots are zeroed (removed_seq=NOT_REMOVED) — like the
+    XLA sort path, slots at or beyond count are semantically ignored."""
+    from ..core.constants import NOT_REMOVED
+    S = c["seq"].shape[-1]
+    active = _iota2(c["seq"].shape) < c["count"][:, None]
+    keep = active & ~(c["removed_seq"] <= min_seq[:, None])
+    # dropped-before count: exclusive prefix sum of ~keep over active slots
+    dropped = jnp.where(active & ~keep, 1, 0)
+    d = _excl_cumsum_last(dropped)
+
+    occ = keep
+    planes = {k: c[k] for k in _PLANES}
+    idx = _iota2(c["seq"].shape)
+    step = 1
+    while step < S:
+        b_set = occ & (((d // step) % 2) == 1)
+        # mask the roll's wraparound: position p receives from p+step only
+        # when p+step is in range (the head wrapping to the tail must not
+        # masquerade as an incoming element). Roll an int32 mask — Mosaic
+        # cannot roll i1 vectors.
+        b_set_i = jnp.where(b_set, 1, 0)
+        moves_in = (jnp.roll(b_set_i, -step, axis=-1) == 1) & \
+            (idx < S - step)
+        stays = occ & ~b_set
+        for k in _PLANES:
+            incoming = jnp.roll(planes[k], -step, axis=-1)
+            planes[k] = jnp.where(moves_in, incoming,
+                                  jnp.where(stays, planes[k], 0))
+        d = jnp.where(moves_in, jnp.roll(d, -step, axis=-1), d)
+        occ = moves_in | stays
+        step *= 2
+    planes["removed_seq"] = jnp.where(occ, planes["removed_seq"],
+                                      NOT_REMOVED)
+    out = dict(c)
+    out.update(planes)
+    out["count"] = jnp.sum(keep.astype(jnp.int32), axis=-1)
+    return out
+
+
+def _iota2(shape):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+
+
+def _excl_cumsum_last(x):
+    """Exclusive prefix sum along the last axis: the shared Hillis-Steele
+    inclusive scan, shifted right by one."""
+    c = _cumsum(x)
+    return jnp.where(_iota2(x.shape) == 0, 0, jnp.roll(c, 1, axis=-1))
+
+
+def _kernel(*refs, compact: bool):
+    if compact:
+        ms_ref, refs = refs[0], refs[1:]
     op_refs = refs[:_OPS]
     plane_refs = refs[_OPS:_OPS + _NP]
     cnt_ref, ovf_ref = refs[_OPS + _NP:_OPS + _NP + 2]
@@ -73,6 +133,8 @@ def _kernel(*refs):
         return {key: pick(key) for key in c}
 
     out = jax.lax.fori_loop(0, n_ops, body, carry)
+    if compact:
+        out = _compact(out, ms_ref[:, 0])
     for name, ref in zip(_PLANES, out_plane_refs):
         ref[:] = out[name]
     out_cnt_ref[:, 0] = out["count"]
@@ -80,15 +142,19 @@ def _kernel(*refs):
 
 
 def apply_string_batch_pallas(state: StringState, kind, a0, a1, a2, seq,
-                              client, ref_seq, tile: int = 128,
+                              client, ref_seq, min_seq=None, tile: int = 128,
                               interpret: bool = False) -> StringState:
-    """Drop-in equivalent of ``apply_string_batch(..., with_props=False)``.
+    """Drop-in equivalent of ``apply_string_batch(..., with_props=False)``,
+    optionally fused with zamboni: pass ``min_seq`` (D,) to compact each
+    doc inside the kernel epilogue while the planes are still in VMEM —
+    one dispatch, one HBM round-trip for apply + compact.
 
     D must divide by ``tile``; S should be a multiple of 128 (lane width).
     ``interpret=True`` runs the Pallas interpreter (CPU tests)."""
     D, S = state.seq.shape
     O = kind.shape[1]
     assert D % tile == 0, f"doc count {D} not divisible by tile {tile}"
+    compact = min_seq is not None
 
     op_spec = pl.BlockSpec((tile, O), lambda i: (i, 0),
                            memory_space=pltpu.VMEM)
@@ -97,9 +163,11 @@ def apply_string_batch_pallas(state: StringState, kind, a0, a1, a2, seq,
     col_spec = pl.BlockSpec((tile, 1), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
 
+    n_lead = 1 if compact else 0
     grid_spec = pl.GridSpec(
         grid=(D // tile,),
-        in_specs=[op_spec] * _OPS + [plane_spec] * _NP + [col_spec] * 2,
+        in_specs=[col_spec] * n_lead + [op_spec] * _OPS
+        + [plane_spec] * _NP + [col_spec] * 2,
         out_specs=tuple([plane_spec] * _NP + [col_spec] * 2),
     )
     out_shape = tuple(
@@ -107,11 +175,13 @@ def apply_string_batch_pallas(state: StringState, kind, a0, a1, a2, seq,
         + [jax.ShapeDtypeStruct((D, 1), jnp.int32)] * 2)
 
     # donate the state planes into the outputs (in-place update in HBM)
-    aliases = {_OPS + i: i for i in range(_NP + 2)}
+    aliases = {n_lead + _OPS + i: i for i in range(_NP + 2)}
+    lead = (jnp.asarray(min_seq, jnp.int32)[:, None],) if compact else ()
     outs = pl.pallas_call(
-        _kernel, grid_spec=grid_spec, out_shape=out_shape,
+        functools.partial(_kernel, compact=compact),
+        grid_spec=grid_spec, out_shape=out_shape,
         input_output_aliases=aliases, interpret=interpret,
-    )(kind, a0, a1, a2, seq, client, ref_seq,
+    )(*lead, kind, a0, a1, a2, seq, client, ref_seq,
       *(getattr(state, k) for k in _PLANES),
       state.count[:, None], state.overflow[:, None])
 

@@ -68,3 +68,36 @@ def test_pallas_overflow_flag_not_corruption():
                                     tile=8, interpret=True)
     _assert_equal(ref, out)
     assert np.asarray(out.overflow).any()
+
+
+def test_pallas_fused_compaction_matches_xla_apply_then_compact():
+    """min_seq fused into the kernel epilogue (bit-shift stream compaction
+    in VMEM) must match XLA apply + sort-based compact exactly on the
+    active region, including stability (kept-slot order)."""
+    from fluidframework_tpu.ops.merge_tree_kernel import (
+        compact_string_state, string_state_digest,
+    )
+    for seed in range(3):
+        sp = StringState.create(8, 128)
+        sx = StringState.create(8, 128)
+        seq = 1
+        for r in range(3):
+            planes, seq = typing_storm(8, 16, seed=seed * 10 + r,
+                                       start_seq=seq)
+            ops = tuple(jnp.asarray(planes[k]) for k in ORDER)
+            ms = np.full((8,), max(seq - 17, 0), np.int32)  # partial window
+            sp = apply_string_batch_pallas(sp, *ops, min_seq=ms, tile=8,
+                                           interpret=True)
+            sx = compact_string_state(apply_string_batch(sx, *ops),
+                                      jnp.asarray(ms))
+            cnt = np.asarray(sp.count)
+            assert np.array_equal(cnt, np.asarray(sx.count)), (seed, r)
+            for k in ("seq", "client", "removed_seq", "removers", "length",
+                      "handle_op", "handle_off"):
+                a = np.asarray(getattr(sp, k))
+                b = np.asarray(getattr(sx, k))
+                for d in range(8):
+                    assert np.array_equal(a[d, :cnt[d]], b[d, :cnt[d]]), \
+                        (k, seed, r, d)
+            assert np.array_equal(np.asarray(string_state_digest(sp)),
+                                  np.asarray(string_state_digest(sx)))
