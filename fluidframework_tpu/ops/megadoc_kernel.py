@@ -134,9 +134,10 @@ def _megadoc_apply_local(n_shards, sd, kind, a0, a1, a2, seq, client,
     return out
 
 
-def apply_megadoc_batch(mesh: Mesh, state: StringState, kind, a0, a1, a2,
-                        seq, client, ref_seq) -> StringState:
-    """Apply a dense (D, O) sequenced batch to D seg-sharded mega-docs."""
+@functools.lru_cache(maxsize=8)
+def _apply_megadoc_fn(mesh: Mesh):
+    """Jitted shard_map apply for one mesh — cached so repeated batches hit
+    the jit cache instead of re-tracing a fresh shard_map closure."""
     op_spec = P(None, None)
 
     @functools.partial(
@@ -147,15 +148,19 @@ def apply_megadoc_batch(mesh: Mesh, state: StringState, kind, a0, a1, a2,
         return _widen(_megadoc_apply_local(mesh.devices.size, _narrow(sd),
                                            *ops))
 
-    sd = _state_dict(state)
-    out = run(sd, kind, a0, a1, a2, seq, client, ref_seq)
+    return jax.jit(run)
+
+
+def apply_megadoc_batch(mesh: Mesh, state: StringState, kind, a0, a1, a2,
+                        seq, client, ref_seq) -> StringState:
+    """Apply a dense (D, O) sequenced batch to D seg-sharded mega-docs."""
+    out = _apply_megadoc_fn(mesh)(_state_dict(state), kind, a0, a1, a2, seq,
+                                  client, ref_seq)
     return StringState(**out)
 
 
-def megadoc_digest(mesh: Mesh, state: StringState) -> jax.Array:
-    """Content digest of each mega-doc, equal to ``string_state_digest`` of
-    the same content held unsharded (global visible prefix via collective)."""
-
+@functools.lru_cache(maxsize=8)
+def _digest_fn(mesh: Mesh):
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(_SPEC,) * 6,
@@ -176,8 +181,25 @@ def megadoc_digest(mesh: Mesh, state: StringState) -> jax.Array:
         part = jnp.sum(jnp.where(live, mix, 0), axis=1) + local_tot
         return jax.lax.psum(part, SEG_AXIS)
 
-    return run(state.seq, state.removed_seq, state.length, state.handle_op,
-               state.handle_off, state.count)
+    return jax.jit(run)
+
+
+def megadoc_digest(mesh: Mesh, state: StringState) -> jax.Array:
+    """Content digest of each mega-doc, equal to ``string_state_digest`` of
+    the same content held unsharded (global visible prefix via collective)."""
+    return _digest_fn(mesh)(state.seq, state.removed_seq, state.length,
+                            state.handle_op, state.handle_off, state.count)
+
+
+@functools.lru_cache(maxsize=8)
+def _compact_fn(mesh: Mesh):
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(STATE_SPECS, P(None)), out_specs=STATE_SPECS)
+    def run(sd, ms):
+        local = StringState(**_narrow(sd))
+        return _widen(_state_dict(compact_string_state(local, ms)))
+
+    return jax.jit(run)
 
 
 def compact_megadoc(mesh: Mesh, state: StringState, min_seq) -> StringState:
@@ -187,14 +209,8 @@ def compact_megadoc(mesh: Mesh, state: StringState, min_seq) -> StringState:
     the same stable-partition sort as ``compact_string_state`` — no
     communication needed, since slot ownership never crosses shards; only
     the host rebalancer (overflow path) moves segments between shards."""
-
-    @functools.partial(jax.shard_map, mesh=mesh,
-                       in_specs=(STATE_SPECS, P(None)), out_specs=STATE_SPECS)
-    def run(sd, ms):
-        local = StringState(**_narrow(sd))
-        return _widen(_state_dict(compact_string_state(local, ms)))
-
-    out = run(_state_dict(state), jnp.asarray(min_seq, jnp.int32))
+    out = _compact_fn(mesh)(_state_dict(state),
+                            jnp.asarray(min_seq, jnp.int32))
     return StringState(**out)
 
 

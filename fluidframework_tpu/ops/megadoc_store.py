@@ -1,0 +1,140 @@
+"""Host facade for mega-docs: very long documents sharded across the mesh.
+
+Mirrors ``TensorStringStore`` (payload interning, client indexing, text and
+property reads — translation shared via ``StringOpInterner``) for documents
+whose segment axis is distributed over the device mesh by
+``megadoc_kernel`` — the framework's sequence/context-parallel serving
+path. The host orchestrates the distributed zamboni: batches are applied in
+windows sized so a shard below the rebalance threshold can never overflow
+within one window, with a preemptive rebalance check between windows
+(overflow means dropped ops and an oracle rebuild).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .megadoc_kernel import (
+    apply_megadoc_batch, compact_megadoc, create_megadoc_state,
+    make_megadoc_mesh, megadoc_digest, rebalance_megadoc, visible_runs,
+)
+from .schema import OpKind
+from .string_store import _TEXT, StringOpInterner
+
+
+class MegaDocStringStore(StringOpInterner):
+    """D mega-docs, each sharded over every device of a 1-D mesh."""
+
+    def __init__(self, n_docs: int, capacity_per_shard: int = 256,
+                 mesh=None, rebalance_headroom: float = 0.25):
+        self.mesh = mesh if mesh is not None else make_megadoc_mesh()
+        self.n_docs = n_docs
+        self.capacity_per_shard = capacity_per_shard
+        self.rebalance_headroom = rebalance_headroom
+        self.state = create_megadoc_state(self.mesh, n_docs,
+                                          capacity_per_shard)
+        self._init_interner(n_docs, self.state.prop_val.shape[2])
+        self._runs_cache = None
+        self._runs_state = None
+
+    # ----------------------------------------------------------------- apply
+
+    def apply_messages(self, messages) -> None:
+        """messages: iterable of (doc, SequencedDocumentMessage) carrying
+        merge-tree op contents; same contract as TensorStringStore."""
+        per_doc: Dict[int, list] = {}
+        for doc, msg in messages:
+            recs = self._records_for(doc, msg)
+            if recs:
+                per_doc.setdefault(doc, []).extend(recs)
+        if not per_doc:
+            return
+        # Window the op axis so preemptive rebalances interleave: a fresh
+        # mega-doc concentrates inserts on one shard, and each op can add
+        # up to 2 slots there, so a window of headroom/2 ops can never push
+        # a below-threshold shard past its capacity before the next check.
+        window = max(1, int(self.capacity_per_shard *
+                            self.rebalance_headroom) // 2)
+        widest = max(len(v) for v in per_doc.values())
+        off = 0
+        while off < widest:
+            chunk = {d: recs[off:off + window]
+                     for d, recs in per_doc.items() if len(recs) > off}
+            self._maybe_rebalance()
+            self._apply_chunk(chunk)
+            off += window
+
+    def _apply_chunk(self, per_doc: Dict[int, list]) -> None:
+        import jax.numpy as jnp
+        widest = max(len(v) for v in per_doc.values())
+        o = 8
+        while o < widest:
+            o *= 2
+        planes = np.zeros((7, self.n_docs, o), np.int32)
+        planes[0, :, :] = int(OpKind.NOOP)
+        for doc, recs in per_doc.items():
+            for j, rec in enumerate(recs):
+                planes[:, doc, j] = rec
+        self.state = apply_megadoc_batch(
+            self.mesh, self.state, *(jnp.asarray(planes[i])
+                                     for i in range(7)))
+
+    def _maybe_rebalance(self) -> None:
+        """Preemptive distributed zamboni: spread slots when any shard is
+        within ``rebalance_headroom`` of its capacity. Overflowed state is
+        left untouched (sticky flag preserved for the oracle-drain path)."""
+        if np.asarray(self.state.overflow).any():
+            return
+        counts = np.asarray(self.state.count)
+        threshold = self.capacity_per_shard * (1 - self.rebalance_headroom)
+        if counts.max() > threshold:
+            self.state = rebalance_megadoc(self.mesh, self.state)
+
+    def compact(self, min_seq) -> None:
+        ms = np.full((self.n_docs,), int(min_seq), np.int32) \
+            if np.isscalar(min_seq) else np.asarray(min_seq, np.int32)
+        self.state = compact_megadoc(self.mesh, self.state, ms)
+
+    # ----------------------------------------------------------------- reads
+
+    def _runs(self):
+        """visible_runs pulled device→host once per state version (the
+        state object is replaced by apply/compact/rebalance)."""
+        if self._runs_state is not self.state:
+            self._runs_cache = visible_runs(self.state)
+            self._runs_state = self.state
+        return self._runs_cache
+
+    def read_text(self, doc: int) -> str:
+        parts = []
+        for op, off, ln, _props in self._runs()[doc]:
+            kind, text = self._payloads[op]
+            if kind == _TEXT:
+                parts.append(text[off:off + ln])
+        return "".join(parts)
+
+    def visible_length(self, doc: int) -> int:
+        return sum(ln for _op, _off, ln, _p in self._runs()[doc])
+
+    def get_properties(self, doc: int, pos: int) -> dict:
+        """Properties of the character at visible position pos."""
+        at = 0
+        for _op, _off, ln, props in self._runs()[doc]:
+            if at <= pos < at + ln:
+                return {key: self._prop_values.value(int(props[plane]))
+                        for key, plane in self._prop_planes.items()
+                        if props[plane] != 0}
+            at += ln
+        raise IndexError(f"doc {doc}: position {pos} beyond length {at}")
+
+    def overflowed(self) -> np.ndarray:
+        return np.asarray(self.state.overflow)
+
+    def digests(self) -> np.ndarray:
+        return np.asarray(megadoc_digest(self.mesh, self.state))
+
+    def slot_usage(self) -> np.ndarray:
+        """(D, n_shards) active slot counts."""
+        return np.asarray(self.state.count)

@@ -28,24 +28,22 @@ _TEXT = 0
 _MARKER = 1
 
 
-class TensorStringStore:
-    def __init__(self, n_docs: int, capacity: int = 256, n_props: int = 4):
-        self.n_docs = n_docs
-        self.capacity = capacity
-        self.n_props = n_props
-        self.state = StringState.create(n_docs, capacity, n_props)
+class StringOpInterner:
+    """Shared host-side message→op-record translation for the flat and
+    mega-doc stores: payload/client/property interning and the
+    insert-with-props → insert + same-seq annotate expansion. One
+    implementation so the two serving facades cannot drift apart."""
+
+    def _init_interner(self, n_docs: int, n_props: int) -> None:
         self._payloads: List[Tuple[int, str]] = [(_TEXT, "")]  # handle 0
-        self._client_idx: List[Dict[int, int]] = [dict() for _ in range(n_docs)]
+        self._client_idx: List[Dict[int, int]] = [dict()
+                                                  for _ in range(n_docs)]
         # annotate: property KEYS intern to plane indexes (store-wide),
-        # VALUES intern to handles; handle 0 = key unset (None deletes).
-        # Until the first annotate arrives the kernels run in the no-props
-        # mode (all-zero planes are permutation-invariant; skipping their
-        # movement saves ~35% HBM traffic on the hot path).
+        # VALUES intern to handles; handle 0 = key unset (None deletes)
         self._prop_planes: Dict[str, int] = {}
         self._prop_values = ValueInterner()
         self._has_props = False
-
-    # ------------------------------------------------------------- interning
+        self.n_props = n_props
 
     def _client(self, doc: int, client_id: int) -> int:
         m = self._client_idx[doc]
@@ -76,6 +74,59 @@ class TensorStringStore:
             raise OverflowError("property value table exceeded 2^20 entries")
         return h
 
+    def _annotate_rec(self, key, value, start, end, seq, cl, ref_seq):
+        self._has_props = True
+        packed = (self._prop_plane(key) << PROP_HANDLE_BITS) | \
+            self._prop_handle(value)
+        return (int(OpKind.STR_ANNOTATE), start, end, packed, seq, cl,
+                ref_seq)
+
+    def _records_for(self, doc: int, msg) -> list:
+        """Device op records (7-tuples) for one sequenced message."""
+        op = msg.contents
+        cl = self._client(doc, msg.client_id)
+        if op["mt"] == "insert":
+            if op["kind"] == 1:  # marker
+                handle = self._payload(_MARKER, "")
+                length = 1
+            else:
+                if not op["text"]:
+                    return []  # empty insert: no segment anywhere
+                handle = self._payload(_TEXT, op["text"])
+                length = len(op["text"])
+            recs = [(int(OpKind.STR_INSERT), op["pos"], length, handle,
+                     msg.seq, cl, msg.ref_seq)]
+            # insert-with-props = insert + same-seq annotate of the new
+            # segment: in the op's own perspective the inserted run occupies
+            # exactly [pos, pos+len) and nothing else visible moved, so the
+            # annotate targets only it
+            for key in sorted(op.get("props") or {}):
+                recs.append(self._annotate_rec(
+                    key, op["props"][key], op["pos"], op["pos"] + length,
+                    msg.seq, cl, msg.ref_seq))
+            return recs
+        if op["mt"] == "remove":
+            return [(int(OpKind.STR_REMOVE), op["start"], op["end"], 0,
+                     msg.seq, cl, msg.ref_seq)]
+        if op["mt"] == "annotate":
+            # one device record per property key (the kernel's per-key LWW
+            # planes); all records share the message's seq
+            return [self._annotate_rec(key, op["props"][key], op["start"],
+                                       op["end"], msg.seq, cl, msg.ref_seq)
+                    for key in sorted(op["props"])]
+        raise ValueError(f"unknown op {op['mt']!r}")
+
+
+class TensorStringStore(StringOpInterner):
+    def __init__(self, n_docs: int, capacity: int = 256, n_props: int = 4):
+        self.n_docs = n_docs
+        self.capacity = capacity
+        # until the first annotate arrives the kernels run in the no-props
+        # mode (all-zero planes are permutation-invariant; skipping their
+        # movement saves ~35% HBM traffic on the hot path)
+        self.state = StringState.create(n_docs, capacity, n_props)
+        self._init_interner(n_docs, n_props)
+
     # ----------------------------------------------------------------- apply
 
     def apply_messages(self, messages) -> None:
@@ -83,52 +134,9 @@ class TensorStringStore:
         merge-tree op contents (the ``mt`` dicts of SequenceClient)."""
         per_doc: Dict[int, list] = {}
         for doc, msg in messages:
-            op = msg.contents
-            cl = self._client(doc, msg.client_id)
-            if op["mt"] == "insert":
-                if op["kind"] == 1:  # marker
-                    handle = self._payload(_MARKER, "")
-                    length = 1
-                else:
-                    if not op["text"]:
-                        continue  # empty insert: no segment anywhere
-                    handle = self._payload(_TEXT, op["text"])
-                    length = len(op["text"])
-                rec = (int(OpKind.STR_INSERT), op["pos"], length, handle,
-                       msg.seq, cl, msg.ref_seq)
-                if op.get("props"):
-                    # insert-with-props = insert + same-seq annotate of the
-                    # new segment: in the op's own perspective the inserted
-                    # run occupies exactly [pos, pos+len), and nothing else
-                    # visible moved, so the annotate targets only it
-                    per_doc.setdefault(doc, []).append(rec)
-                    self._has_props = True
-                    for key in sorted(op["props"]):
-                        packed = (self._prop_plane(key)
-                                  << PROP_HANDLE_BITS) | \
-                            self._prop_handle(op["props"][key])
-                        per_doc[doc].append(
-                            (int(OpKind.STR_ANNOTATE), op["pos"],
-                             op["pos"] + length, packed, msg.seq, cl,
-                             msg.ref_seq))
-                    continue
-            elif op["mt"] == "remove":
-                rec = (int(OpKind.STR_REMOVE), op["start"], op["end"], 0,
-                       msg.seq, cl, msg.ref_seq)
-            elif op["mt"] == "annotate":
-                # one device record per property key (the kernel's per-key
-                # LWW planes); all records share the message's seq
-                self._has_props = True
-                for key in sorted(op["props"]):
-                    packed = (self._prop_plane(key) << PROP_HANDLE_BITS) | \
-                        self._prop_handle(op["props"][key])
-                    per_doc.setdefault(doc, []).append(
-                        (int(OpKind.STR_ANNOTATE), op["start"], op["end"],
-                         packed, msg.seq, cl, msg.ref_seq))
-                continue
-            else:
-                raise ValueError(f"unknown op {op['mt']!r}")
-            per_doc.setdefault(doc, []).append(rec)
+            recs = self._records_for(doc, msg)
+            if recs:
+                per_doc.setdefault(doc, []).extend(recs)
         if not per_doc:
             return
         # power-of-two op-axis buckets keep jit cache hits (static shapes)
