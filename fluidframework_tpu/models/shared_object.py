@@ -104,6 +104,20 @@ class SharedObject:
     def load_core(self, summary: dict) -> None:
         raise NotImplementedError
 
+    def load_from_summary(self, summary: dict, base_seq: int = 0) -> None:
+        """Load state captured at sequence number ``base_seq`` (reference:
+        the channel ``.attributes`` sequence number). Subsequent ops must
+        carry seq > base_seq, and locally-submitted ops reference it — a
+        summary's segments keep their original sequence stamps, so a
+        perspective below base_seq cannot see them."""
+        self.load_core(summary)
+        self.last_processed_seq = base_seq
+        self.on_loaded(base_seq)
+
+    def on_loaded(self, base_seq: int) -> None:
+        """Hook for subclasses holding inner sequence state (e.g. the
+        merge-tree client mirror) to adopt the summary's base seq."""
+
 
 class ChannelFactory:
     """Creates/loads one DDS type (reference: IChannelFactory)."""
@@ -115,9 +129,10 @@ class ChannelFactory:
     def create(self, object_id: str, client_id: int) -> SharedObject:
         return self.cls(object_id, client_id)
 
-    def load(self, object_id: str, client_id: int, summary: dict) -> SharedObject:
+    def load(self, object_id: str, client_id: int, summary: dict,
+             base_seq: int = 0) -> SharedObject:
         obj = self.cls(object_id, client_id)
-        obj.load_core(summary)
+        obj.load_from_summary(summary, base_seq)
         return obj
 
 

@@ -261,6 +261,12 @@ class SharedString(SharedObject):
         return {"type": self.TYPE, "tree": tree_summary,
                 "collections": collections}
 
+    def on_loaded(self, base_seq: int) -> None:
+        # keep the inner merge-tree client's seq mirror (maintained by
+        # process_core on every op) consistent with the summary's base:
+        # its value stamps ref_seq on locally-submitted ops
+        self.client.last_processed_seq = base_seq
+
     def load_core(self, summary: dict) -> None:
         self.client.tree = MergeTree.load(summary["tree"], self.client_id)
         for label, items in summary.get("collections", {}).items():

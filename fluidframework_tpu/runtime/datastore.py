@@ -59,7 +59,8 @@ class FluidDataStoreRuntime:
         if channel_id not in self._channels:
             summary = self._pending_summaries.pop(channel_id)
             channel = self.registry.get(summary["type"]).load(
-                channel_id, self.client_id, summary)
+                channel_id, self.client_id, summary,
+                summary.get("baseSeq", 0))
             self._wire(channel)
             self._channels[channel_id] = channel
         return self._channels[channel_id]
@@ -119,7 +120,11 @@ class FluidDataStoreRuntime:
         """Summary subtree: one entry per channel (realized channels
         summarize live; unrealized ones pass their loaded summary through —
         reference: summarizer handle reuse for unchanged subtrees)."""
-        channels = {cid: ch.summarize()
+        # baseSeq records each channel's capture point (reference: the
+        # .attributes sequence number) so realization restores the base
+        # perspective; unrealized passthrough summaries keep their original
+        channels = {cid: dict(ch.summarize(),
+                              baseSeq=ch.last_processed_seq)
                     for cid, ch in self._channels.items()}
         channels.update(self._pending_summaries)
         return {"channels": channels}

@@ -1,0 +1,110 @@
+"""Regenerate the golden summary fixtures (run ONLY on an intentional
+format change: ``python tests/goldens/generate.py``).
+
+Each fixture stores a DDS summary produced by a deterministic edit script
+plus the reads a loader must reproduce. ``test_golden_snapshots.py`` loads
+the CHECKED-IN files — never regenerates — so an accidental format change
+breaks the test instead of silently rewriting history (reference:
+test-snapshots golden suite, SURVEY.md §4)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from fluidframework_tpu.models import (  # noqa: E402
+    SharedMap, SharedMatrix, SharedString,
+)
+from fluidframework_tpu.models.shared_tree import SharedTree  # noqa: E402
+from fluidframework_tpu.testing.mocks import (  # noqa: E402
+    MockSequencer, create_connected_dds,
+)
+
+OUT = os.path.dirname(os.path.abspath(__file__))
+
+
+def save(name, summary, expect, base_seq):
+    with open(os.path.join(OUT, name), "w") as f:
+        json.dump({"summary": summary, "expect": expect,
+                   "base_seq": base_seq}, f, indent=1, sort_keys=True)
+    print("wrote", name)
+
+
+def gen_string():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedString)
+    b = create_connected_dds(seqr, SharedString)
+    a.insert_text(0, "hello world")
+    b.insert_text(0, "## ")
+    seqr.process_all_messages()
+    a.annotate_range(3, 8, {"bold": True, "color": "red"})
+    b.remove_text(9, 11)
+    a.insert_marker(a.get_length())
+    seqr.process_all_messages()
+    b.annotate_range(4, 6, {"color": None})  # delete color on a span
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text()
+    expect = {
+        "text": a.get_text(),
+        "length": a.get_length(),
+        "props": [[p, a.get_properties(p)] for p in range(a.get_length())],
+    }
+    save("shared_string_v1.json", a.summarize(), expect, seqr.seq)
+
+
+def gen_map():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedMap)
+    b = create_connected_dds(seqr, SharedMap)
+    a.set("title", "golden")
+    b.set("count", 3)
+    a.set("nested", {"x": [1, 2, 3]})
+    seqr.process_all_messages()
+    b.delete("count")
+    seqr.process_all_messages()
+    save("shared_map_v1.json", a.summarize(),
+         {"entries": {k: a.get(k) for k in ("title", "nested")},
+          "absent": ["count"]}, seqr.seq)
+
+
+def gen_matrix():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedMatrix)
+    b = create_connected_dds(seqr, SharedMatrix)
+    a.insert_rows(0, 3)
+    a.insert_cols(0, 3)
+    seqr.process_all_messages()
+    for r in range(3):
+        for c in range(3):
+            a.set_cell(r, c, r * 10 + c)
+    b.remove_rows(1, 1)
+    seqr.process_all_messages()
+    cells = [[a.get_cell(r, c) for c in range(a.col_count)]
+             for r in range(a.row_count)]
+    save("shared_matrix_v1.json", a.summarize(),
+         {"rows": a.row_count, "cols": a.col_count, "cells": cells},
+         seqr.seq)
+
+
+def gen_tree():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedTree)
+    b = create_connected_dds(seqr, SharedTree)
+    n1 = a.insert("root", "children", value={"title": "golden"})
+    seqr.process_all_messages()
+    n2 = b.insert(n1, "children", value={"text": "first"})
+    a.insert(n1, "children", value={"text": "zeroth"})
+    seqr.process_all_messages()
+    a.set_value(n2, {"text": "edited"})
+    seqr.process_all_messages()
+    assert a.to_dict() == b.to_dict()
+    save("shared_tree_v1.json", a.summarize(), {"tree": a.to_dict()},
+         seqr.seq)
+
+
+if __name__ == "__main__":
+    gen_string()
+    gen_map()
+    gen_matrix()
+    gen_tree()

@@ -1,0 +1,74 @@
+"""Golden snapshot compatibility: summaries checked in by older code must
+keep loading (reference: the test-snapshots golden suite, SURVEY.md §4).
+
+These tests read the CHECKED-IN fixtures under tests/goldens/ — they never
+regenerate. If a summary format change breaks them, either add a
+backwards-compatible load path or consciously regenerate via
+``python tests/goldens/generate.py`` and say so in the commit message.
+"""
+
+import json
+import os
+
+from fluidframework_tpu.models import SharedMap, SharedMatrix, SharedString
+from fluidframework_tpu.models.shared_tree import SharedTree
+from fluidframework_tpu.testing.mocks import (
+    MockSequencer, create_connected_dds,
+)
+
+GOLDENS = os.path.join(os.path.dirname(__file__), "goldens")
+
+
+def _load(name, cls):
+    with open(os.path.join(GOLDENS, name)) as f:
+        fixture = json.load(f)
+    dds = create_connected_dds(MockSequencer(), cls)
+    dds.load_from_summary(fixture["summary"], fixture["base_seq"])
+    return dds, fixture["expect"]
+
+
+def test_golden_shared_string_loads():
+    s, expect = _load("shared_string_v1.json", SharedString)
+    assert s.get_text() == expect["text"]
+    assert s.get_length() == expect["length"]
+    for pos, props in expect["props"]:
+        assert s.get_properties(pos) == props, pos
+
+
+def test_golden_shared_map_loads():
+    m, expect = _load("shared_map_v1.json", SharedMap)
+    for k, v in expect["entries"].items():
+        assert m.get(k) == v, k
+    for k in expect["absent"]:
+        assert m.get(k) is None, k
+
+
+def test_golden_shared_matrix_loads():
+    m, expect = _load("shared_matrix_v1.json", SharedMatrix)
+    assert m.row_count == expect["rows"]
+    assert m.col_count == expect["cols"]
+    for r in range(expect["rows"]):
+        for c in range(expect["cols"]):
+            assert m.get_cell(r, c) == expect["cells"][r][c], (r, c)
+
+
+def test_golden_shared_tree_loads():
+    t, expect = _load("shared_tree_v1.json", SharedTree)
+    assert t.to_dict() == expect["tree"]
+
+
+def test_golden_loaded_string_accepts_new_edits():
+    """A loaded document must keep collaborating, not just read back."""
+    with open(os.path.join(GOLDENS, "shared_string_v1.json")) as f:
+        fixture = json.load(f)
+    seqr = MockSequencer()
+    seqr.seq = fixture["base_seq"]  # resume the stream past the summary
+    a = create_connected_dds(seqr, SharedString)
+    b = create_connected_dds(seqr, SharedString)
+    a.load_from_summary(fixture["summary"], fixture["base_seq"])
+    b.load_from_summary(fixture["summary"], fixture["base_seq"])
+    a.insert_text(0, ">> ")
+    b.insert_text(b.get_length(), " <<")
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == \
+        ">> " + fixture["expect"]["text"] + " <<"
