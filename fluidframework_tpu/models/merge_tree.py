@@ -577,6 +577,39 @@ class MergeTree:
             tree.segments.append(seg)
         return tree
 
+    def visible_at_pending(self, seg: "Segment", k: int) -> bool:
+        """Visibility in the perspective a receiver will have when this
+        client's pending op ``k`` applies after resubmission: everything
+        acked, plus this client's pending ops with smaller local ids (they
+        are resubmitted, and therefore sequenced, before op ``k``).
+        Reconnect-critical logic shared by the sequence client and the
+        matrix axes — must not fork."""
+        inserted = seg.seq != SEQ_UNASSIGNED or (
+            seg.local_insert_op is not None and seg.local_insert_op < k)
+        if not inserted:
+            return False
+        if seg.removed_seq is None:
+            return True
+        if seg.removed_seq != SEQ_UNASSIGNED:
+            return False                       # acked remove
+        return not (seg.local_remove_op is not None
+                    and seg.local_remove_op < k)
+
+    def set_local_client(self, new_client_id: int) -> None:
+        """Adopt a reconnect's new client id: re-stamp pending segments and
+        pending removers (acked stamps are history and stay). Shared by
+        SequenceClient.set_client_id and the matrix axes — reconnect-critical
+        logic that must not fork."""
+        old = self.local_client
+        if new_client_id == old:
+            return
+        for seg in self.segments:
+            if seg.client == old and seg.seq == SEQ_UNASSIGNED:
+                seg.client = new_client_id
+            if old in seg.removers and seg.removed_seq == SEQ_UNASSIGNED:
+                seg.removers[seg.removers.index(old)] = new_client_id
+        self.local_client = new_client_id
+
     def structure_digest(self) -> tuple:
         """Canonical digest of converged acked state, for cross-replica checks
         (the race-detection analog, SURVEY.md §5.2). Ignores pending local ops

@@ -151,34 +151,12 @@ class SequenceClient:
     # ------------------------------------------------- reconnect regeneration
 
     def set_client_id(self, new_client_id: int) -> None:
-        """Adopt a reconnect's new client id: re-stamp pending segments and
-        pending removers (acked stamps are history and stay)."""
-        old = self.client_id
-        if new_client_id == old:
-            return
-        for seg in self.tree.segments:
-            if seg.client == old and seg.seq == SEQ_UNASSIGNED:
-                seg.client = new_client_id
-            if old in seg.removers and seg.removed_seq == SEQ_UNASSIGNED:
-                seg.removers[seg.removers.index(old)] = new_client_id
+        """Adopt a reconnect's new client id (re-stamps pending segments)."""
+        self.tree.set_local_client(new_client_id)
         self.client_id = new_client_id
-        self.tree.local_client = new_client_id
 
     def _visible_at_local(self, seg, k: int) -> bool:
-        """Visibility in the perspective a receiver will have when our
-        pending op ``k`` applies after resubmission: everything acked, plus
-        our pending ops with smaller local ids (they are resubmitted, and
-        therefore sequenced, before op ``k``)."""
-        inserted = seg.seq != SEQ_UNASSIGNED or (
-            seg.local_insert_op is not None and seg.local_insert_op < k)
-        if not inserted:
-            return False
-        if seg.removed_seq is None:
-            return True
-        if seg.removed_seq != SEQ_UNASSIGNED:
-            return False                       # acked remove
-        return not (seg.local_remove_op is not None
-                    and seg.local_remove_op < k)
+        return self.tree.visible_at_pending(seg, k)
 
     def regenerate_pending_ops(self, new_client_id=None):
         """Rebase every pending local op for resubmission on a new
@@ -266,6 +244,14 @@ class SequenceClient:
 
         for seg in self.tree.segments:
             if mine(seg):
+                # a pending annotate may have split this insert's segments
+                # and changed props on SOME pieces: coalescing across a
+                # property boundary would stamp one piece's props over the
+                # whole run (remotes would annotate text the originator
+                # never did) — emit one insert op per property run instead
+                if cur is not None and kind == "insert" \
+                        and cur[1][-1].props != seg.props:
+                    close_run()
                 if cur is None:
                     cur = (pos, [seg])
                 else:

@@ -43,13 +43,16 @@ class _Axis:
         return self.tree.get_length()
 
     def insert(self, pos: int, count: int, op_key: Tuple[int, int], seq: int,
-               client: int, ref_seq: int, local_op: Optional[int]) -> None:
+               client: int, ref_seq: int, local_op: Optional[int],
+               key_offset: int = 0) -> None:
         seg = self.tree.insert(
             pos, SegmentKind.TEXT, " " * count, seq, client, ref_seq,
             local_op=local_op,
         )
-        # encode identity through handle so splits keep (opKey, offset) stable
-        seg.handle = (op_key[0] * 1_000_003 + op_key[1], 0)
+        # encode identity through handle so splits keep (opKey, offset)
+        # stable; key_offset carries a rebased split piece's original offset
+        # (a pending insert split by a pending remove resubmits per piece)
+        seg.handle = (op_key[0] * 1_000_003 + op_key[1], key_offset)
 
     def remove(self, start: int, count: int, seq: int, client: int,
                ref_seq: int, local_op: Optional[int]) -> None:
@@ -82,6 +85,7 @@ class SharedMatrix(SharedObject):
         self._pending_cells: Dict[Tuple[Key, Key], int] = {}
         self._op_counter = 0
         self._pending: collections.deque = collections.deque()
+        self._regen_cache = None  # reconnect rebase plan (see rebase_op)
         self.fww = False  # one-way switch to first-writer-wins (reference parity)
 
     # --------------------------------------------------------------- helpers
@@ -230,7 +234,8 @@ class SharedMatrix(SharedObject):
         if kind in ("insRow", "insCol"):
             axis = self.rows if kind == "insRow" else self.cols
             axis.insert(op["pos"], op["count"], tuple(op["opKey"]), msg.seq,
-                        msg.client_id, msg.ref_seq, local_op=None)
+                        msg.client_id, msg.ref_seq, local_op=None,
+                        key_offset=op.get("off", 0))
         elif kind in ("rmRow", "rmCol"):
             axis = self.rows if kind == "rmRow" else self.cols
             axis.remove(op["start"], op["count"], msg.seq, msg.client_id,
@@ -255,6 +260,171 @@ class SharedMatrix(SharedObject):
         for axis in (self.rows, self.cols):
             if min_seq > axis.tree.min_seq:
                 axis.tree.zamboni(min_seq)
+
+    def on_client_id_changed(self, new_client_id: int) -> None:
+        """Re-stamp the axis trees' pending segments for the reconnect's new
+        client id (same contract as SequenceClient.set_client_id). Without
+        this, the echo of a resubmitted row/col insert acks against the OLD
+        local_client, silently leaves the segment pending, and this
+        replica's acked axis diverges from every other replica's."""
+        for axis in (self.rows, self.cols):
+            axis.tree.set_local_client(new_client_id)
+            axis.client_id = new_client_id
+        super().on_client_id_changed(new_client_id)
+
+    # ------------------------------------------------------ reconnect rebase
+
+    def rebase_op(self, contents: dict):
+        """Reconnect resubmission: matrix ops carry axis POSITIONS, which
+        remote ops merged while offline shift — resubmitting them verbatim
+        diverges replicas. Mirror of SharedString.rebase_op: the first
+        drained record triggers one whole-queue regeneration (positions
+        re-resolved per op from its pending segments / stable cell keys in
+        that op's own perspective), then each record returns its plan."""
+        if self._regen_cache is None:
+            self._regen_cache = self._regenerate_pending()
+        ops = self._regen_cache.pop(contents["clientSeq"], None)
+        assert ops is not None, "rebase for unknown pending matrix op"
+        if not self._regen_cache:
+            self._regen_cache = None
+        return ops or None
+
+    def _regen_axis_insert(self, axis, mx: str, k: int):
+        """One insert op per contiguous pending run (a pending remove may
+        have split the original segment): position = perspective-k prefix,
+        identity preserved via (opKey, off) so cell keys keep matching."""
+        ops, pos, emitted = [], 0, 0
+        run = None  # (start, key_handle, key_off, length, segs)
+        for seg in axis.tree.segments:
+            if seg.local_insert_op == k:
+                h, off = seg.handle
+                if run is not None and (run[1] != h or
+                                        run[2] + run[3] != off):
+                    ops.append(run)
+                    run = None
+                if run is None:
+                    run = (pos, h, off, seg.length, [seg])
+                else:
+                    run = (run[0], run[1], run[2], run[3] + seg.length,
+                           run[4] + [seg])
+            elif axis.tree.visible_at_pending(seg, k):
+                if run is not None:
+                    ops.append(run)
+                    run = None
+                pos += seg.length
+        if run is not None:
+            ops.append(run)
+        out = []
+        for start, h, off, length, segs in ops:
+            key = divmod(h, 1_000_003)
+            op = {"mx": mx, "pos": start + emitted, "count": length,
+                  "opKey": [key[0], key[1]]}
+            if off:
+                op["off"] = off
+            out.append((op, segs))
+            emitted += length
+        return out
+
+    def _regen_axis_remove(self, axis, mx: str, k: int):
+        """Pending removes: one op per surviving contiguous run; pieces
+        whose removal was concurrently sequenced drop (the remote remove
+        won; overlapping-remove bookkeeping already recorded us)."""
+        ops, pos = [], 0
+        run = None  # (start, length, segs)
+        for seg in axis.tree.segments:
+            target = seg.local_remove_op == k and \
+                seg.removed_seq == SEQ_UNASSIGNED
+            if target:
+                if run is None:
+                    run = (pos, seg.length, [seg])
+                else:
+                    run = (run[0], run[1] + seg.length, run[2] + [seg])
+                pos += seg.length  # remove targets are perspective-visible
+            else:
+                if axis.tree.visible_at_pending(seg, k):
+                    if run is not None:
+                        ops.append(run)
+                        run = None
+                    pos += seg.length
+                # invisible segments (later pending ops, tombstones) never
+                # affect receiver-side positions: they don't break runs
+        if run is not None:
+            ops.append(run)
+        # receivers apply this op's earlier runs first, which SHRINKS the
+        # positions of later runs (cf. SequenceClient._regen_one's
+        # ``start - emitted`` for removes)
+        out, emitted = [], 0
+        for start, length, segs in ops:
+            out.append(({"mx": mx, "start": start - emitted,
+                         "count": length}, segs))
+            emitted += length
+        return out
+
+    def _key_position(self, axis, key: Key, k: int):
+        """Resolve a stable cell key back to its perspective-k position, or
+        None if the row/col is gone from that perspective."""
+        pos = 0
+        for seg in axis.tree.segments:
+            if not axis.tree.visible_at_pending(seg, k):
+                continue
+            h, off = seg.handle
+            if h == key[0] and off <= key[1] < off + seg.length:
+                return pos + (key[1] - off)
+            pos += seg.length
+        return None
+
+    def _regenerate_pending(self):
+        records = list(self._pending)
+        self._pending.clear()
+        plans = []
+        for op_id, kind, meta in records:
+            if kind in ("insRow", "insCol"):
+                axis = self.rows if kind == "insRow" else self.cols
+                plans.append((op_id, kind, meta,
+                              self._regen_axis_insert(axis, kind, op_id)))
+            elif kind in ("rmRow", "rmCol"):
+                axis = self.rows if kind == "rmRow" else self.cols
+                plans.append((op_id, kind, meta,
+                              self._regen_axis_remove(axis, kind, op_id)))
+            elif kind == "setCell":
+                rk, ck = meta
+                r = self._key_position(self.rows, rk, op_id)
+                c = self._key_position(self.cols, ck, op_id)
+                if r is None or c is None:
+                    # the row/col was removed while in flight: the cell no
+                    # longer exists anywhere — drop, and release the
+                    # optimistic override
+                    n = self._pending_cells.get((rk, ck), 0) - 1
+                    if n <= 0:
+                        self._pending_cells.pop((rk, ck), None)
+                        self._local_over.pop((rk, ck), None)
+                    else:
+                        self._pending_cells[(rk, ck)] = n
+                    plans.append((op_id, kind, meta, []))
+                else:
+                    plans.append((op_id, kind, meta, [(
+                        {"mx": "setCell", "row": r, "col": c,
+                         "value": self._local_over.get((rk, ck))}, None)]))
+            else:  # policy: position-independent
+                plans.append((op_id, kind, meta,
+                              [({"mx": kind}, None)]))
+        out = {}
+        for op_id, kind, meta, runs in plans:
+            ops = []
+            for op, segs in runs:
+                self._op_counter += 1
+                nid = self._op_counter
+                op["clientSeq"] = nid
+                if segs is not None:
+                    for seg in segs:
+                        if kind in ("insRow", "insCol"):
+                            seg.local_insert_op = nid
+                        else:
+                            seg.local_remove_op = nid
+                self._pending.append((nid, kind, meta))
+                ops.append(op)
+            out[op_id] = ops
+        return out
 
     # ------------------------------------------------------------- summaries
 

@@ -1,0 +1,235 @@
+"""Stress/load tier (reference: test-service-load, SURVEY.md §4): many
+containers per document against the in-proc service, randomized op storms
+with disconnect/reconnect (pending-op rebase) and summarization under load,
+then deep convergence checks across every replica."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.framework import LocalClient
+from fluidframework_tpu.runtime import SummaryConfig
+
+SCHEMA = {"initialObjects": {"meta": "map", "text": "sharedString",
+                             "grid": "matrix"}}
+
+
+def _storm(rng, containers, n_ops):
+    """Randomized edits on random replicas; some replicas are offline and
+    accumulate pending ops that rebase at reconnect."""
+    for _ in range(n_ops):
+        c = rng.choice(containers)
+        roll = rng.random()
+        text = c.initial_objects["text"]
+        if roll < 0.35:
+            n = text.get_length()
+            text.insert_text(rng.randint(0, n), f"w{rng.randint(0, 99)} ")
+        elif roll < 0.45 and text.get_length() > 0:
+            start = rng.randrange(text.get_length())
+            end = min(text.get_length(), start + rng.randint(1, 4))
+            text.remove_text(start, end)
+        elif roll < 0.55 and text.get_length() > 0:
+            start = rng.randrange(text.get_length())
+            end = min(text.get_length(), start + rng.randint(1, 6))
+            text.annotate_range(start, end,
+                                {"mark": rng.choice(("a", "b", None))})
+        elif roll < 0.8:
+            c.initial_objects["meta"].set(f"k{rng.randint(0, 30)}",
+                                          rng.randint(0, 1000))
+        else:
+            g = c.initial_objects["grid"]
+            if g.row_count == 0 or (g.row_count < 6 and roll < 0.85):
+                g.insert_rows(rng.randint(0, g.row_count), 1)
+                if g.col_count < 4:
+                    g.insert_cols(rng.randint(0, g.col_count), 1)
+            elif g.col_count > 0:
+                g.set_cell(rng.randrange(g.row_count),
+                           rng.randrange(g.col_count), rng.randint(0, 99))
+
+
+def _assert_converged(containers):
+    texts = {c.initial_objects["text"].get_text() for c in containers}
+    assert len(texts) == 1, texts
+    first = containers[0]
+    length = first.initial_objects["text"].get_length()
+    for c in containers[1:]:
+        for pos in range(length):
+            assert c.initial_objects["text"].get_properties(pos) == \
+                first.initial_objects["text"].get_properties(pos), pos
+        for k in range(31):
+            assert c.initial_objects["meta"].get(f"k{k}") == \
+                first.initial_objects["meta"].get(f"k{k}"), k
+        g0, g1 = first.initial_objects["grid"], c.initial_objects["grid"]
+        assert (g1.row_count, g1.col_count) == (g0.row_count, g0.col_count)
+        for r in range(g0.row_count):
+            for col in range(g0.col_count):
+                assert g1.get_cell(r, col) == g0.get_cell(r, col), (r, col)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_service_load_with_reconnects_and_summaries(seed):
+    rng = random.Random(seed)
+    client = LocalClient(
+        summary_config=SummaryConfig(max_ops=40, max_time_s=1e9))
+    c1, doc_id = client.create_container(SCHEMA)
+    containers = [c1] + [client.get_container(doc_id, SCHEMA)
+                         for _ in range(3)]
+
+    for phase in range(6):
+        _storm(rng, containers, 30)
+        # random connection churn: offline replicas keep editing (pending
+        # ops) and rebase on reconnect
+        for c in containers[1:]:
+            if rng.random() < 0.4 and c.connected:
+                c.disconnect("storm-churn")
+            elif not c.connected:
+                c.connect()
+    for c in containers:
+        if not c.connected:
+            c.connect()
+    _assert_converged(containers)
+
+    # a summary must exist (summarizer ran under load) and late joiners
+    # load from it and still converge
+    summary, seq, _ = client.service.latest_summary(doc_id)
+    assert summary is not None and seq > 0
+    late = client.get_container(doc_id, SCHEMA)
+    assert late.container.base_seq > 0
+    _assert_converged(containers + [late])
+
+
+def test_many_documents_isolated_under_load():
+    rng = random.Random(7)
+    client = LocalClient()
+    docs = []
+    for _ in range(5):
+        c, doc_id = client.create_container(SCHEMA)
+        docs.append((doc_id, [c, client.get_container(doc_id, SCHEMA)]))
+    for _ in range(4):
+        for _doc_id, containers in docs:
+            _storm(rng, containers, 12)
+    for _doc_id, containers in docs:
+        _assert_converged(containers)
+    # documents never bleed into each other
+    texts = [cs[0].initial_objects["text"].get_text() for _d, cs in docs]
+    assert len(set(texts)) == len(texts)  # distinct random streams
+
+
+def test_matrix_offline_insert_rebases_position():
+    """Directed regression: an offline row insert must re-resolve its
+    position against rows sequenced while offline (a verbatim resubmit
+    places it at a stale index and replicas diverge)."""
+    client = LocalClient(enable_summarizer=False)
+    schema = {"initialObjects": {"grid": "matrix"}}
+    c1, doc_id = client.create_container(schema)
+    c2 = client.get_container(doc_id, schema)
+    g1, g2 = c1.initial_objects["grid"], c2.initial_objects["grid"]
+    g1.insert_rows(0, 3)
+    g1.insert_cols(0, 1)
+    for r in range(3):
+        g1.set_cell(r, 0, f"r{r}")
+    c2.disconnect("offline")
+    g2.insert_rows(2, 1)       # between r1 and r2 in c2's view
+    g2.set_cell(2, 0, "X")
+    g1.insert_rows(0, 1)       # sequenced while c2 offline, shifts positions
+    g1.set_cell(0, 0, "front")
+    c2.connect()
+    assert g1.digest() == g2.digest(), (g1.to_lists(), g2.to_lists())
+    assert g1.to_lists() == [["front"], ["r0"], ["r1"], ["X"], ["r2"]]
+
+
+def test_matrix_offline_setcell_on_concurrently_removed_row_drops():
+    """A pending setCell whose row was removed while offline must drop
+    cleanly (the cell no longer exists anywhere)."""
+    client = LocalClient(enable_summarizer=False)
+    schema = {"initialObjects": {"grid": "matrix"}}
+    c1, doc_id = client.create_container(schema)
+    c2 = client.get_container(doc_id, schema)
+    g1, g2 = c1.initial_objects["grid"], c2.initial_objects["grid"]
+    g1.insert_rows(0, 2)
+    g1.insert_cols(0, 1)
+    c2.disconnect("offline")
+    g2.set_cell(1, 0, "doomed")
+    g1.remove_rows(1, 1)        # the row dies while c2 is offline
+    c2.connect()
+    assert g1.digest() == g2.digest()
+    assert g1.row_count == 1
+
+
+def test_matrix_offline_remove_rebases_range():
+    client = LocalClient(enable_summarizer=False)
+    schema = {"initialObjects": {"grid": "matrix"}}
+    c1, doc_id = client.create_container(schema)
+    c2 = client.get_container(doc_id, schema)
+    g1, g2 = c1.initial_objects["grid"], c2.initial_objects["grid"]
+    g1.insert_rows(0, 4)
+    g1.insert_cols(0, 1)
+    for r in range(4):
+        g1.set_cell(r, 0, f"r{r}")
+    c2.disconnect("offline")
+    g2.remove_rows(1, 2)        # removes r1, r2 in c2's view
+    g1.insert_rows(0, 1)        # shifts everything right
+    g1.set_cell(0, 0, "front")
+    c2.connect()
+    assert g1.digest() == g2.digest(), (g1.to_lists(), g2.to_lists())
+    assert g1.to_lists() == [["front"], ["r0"], ["r3"]]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_matrix_reconnect_fuzz(seed):
+    """Randomized matrix-only churn with removes: axis rebase + cell-key
+    stability under offline/online interleavings."""
+    rng = random.Random(seed)
+    client = LocalClient(enable_summarizer=False)
+    schema = {"initialObjects": {"grid": "matrix"}}
+    c1, doc_id = client.create_container(schema)
+    containers = [c1] + [client.get_container(doc_id, schema)
+                         for _ in range(2)]
+    for phase in range(8):
+        for _ in range(15):
+            c = rng.choice(containers)
+            g = c.initial_objects["grid"]
+            roll = rng.random()
+            if g.row_count == 0 or g.col_count == 0 or \
+                    (g.row_count < 7 and roll < 0.4):
+                g.insert_rows(rng.randint(0, g.row_count), 1)
+                if g.col_count < 3:
+                    g.insert_cols(rng.randint(0, g.col_count), 1)
+            elif roll < 0.55 and g.row_count > 1:
+                g.remove_rows(rng.randrange(g.row_count), 1)
+            else:
+                g.set_cell(rng.randrange(g.row_count),
+                           rng.randrange(g.col_count), rng.randint(0, 99))
+        for c in containers[1:]:
+            if rng.random() < 0.5 and c.connected:
+                c.disconnect("churn")
+            elif not c.connected:
+                c.connect()
+    for c in containers:
+        if not c.connected:
+            c.connect()
+    d0 = containers[0].initial_objects["grid"].digest()
+    for c in containers[1:]:
+        assert c.initial_objects["grid"].digest() == d0
+
+
+def test_matrix_offline_split_remove_rebases_both_runs():
+    """Regression: a pending multi-row remove split by a concurrently
+    sequenced INTERIOR insert must rebase its later run with the earlier
+    run's shrinkage accounted for (start - emitted)."""
+    client = LocalClient(enable_summarizer=False)
+    schema = {"initialObjects": {"grid": "matrix"}}
+    c1, doc_id = client.create_container(schema)
+    c2 = client.get_container(doc_id, schema)
+    g1, g2 = c1.initial_objects["grid"], c2.initial_objects["grid"]
+    g1.insert_rows(0, 4)
+    g1.insert_cols(0, 1)
+    for r in range(4):
+        g1.set_cell(r, 0, f"r{r}")
+    c2.disconnect("offline")
+    g2.remove_rows(0, 3)        # removes r0..r2 in c2's view
+    g1.insert_rows(1, 1)        # sequenced INSIDE the removed range
+    g1.set_cell(1, 0, "mid")
+    c2.connect()
+    assert g1.digest() == g2.digest(), (g1.to_lists(), g2.to_lists())
+    assert g1.to_lists() == [["mid"], ["r3"]]
