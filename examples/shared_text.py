@@ -55,6 +55,29 @@ class SharedTextSession:
     def set_title(self, title: str) -> None:
         self.meta.set("title", title)
 
+    def format(self, start: int, end: int, **props) -> None:
+        """Rich-text formatting: per-key LWW annotations (bold=True,
+        color="red", key=None clears)."""
+        self.text.annotate_range(start, end, props)
+
+    def formatted_runs(self):
+        """(text, props) runs of the document — what an editor renders.
+        Walks segments directly: linear, and marker segments (which occupy
+        a position but no text) stay correctly aligned."""
+        runs = []
+        tree = self.text.tree
+        for seg in tree.segments:
+            if not seg.text:
+                continue  # markers occupy a position but render no text
+            if seg.removed_seq is not None:
+                continue  # removed (acked) or pending local remove
+            props = {k: v for k, v in seg.props.items() if v is not None}
+            if runs and runs[-1][1] == props:
+                runs[-1] = (runs[-1][0] + seg.text, props)
+            else:
+                runs.append((seg.text, props))
+        return runs
+
 
 def main() -> int:
     client = LocalClient()
@@ -75,6 +98,14 @@ def main() -> int:
     author1.type_text(author1.text.get_length(), " All replicas converge.")
     author2.type_text(8, "INTRO: ")
 
+    # rich-text formatting: author1 bolds the heading while author3 colors
+    # "merges" — concurrent annotates on different keys both land; a later
+    # annotate overwrites (per-key LWW)
+    author1.format(0, 7, bold=True)
+    author3.format(final_pos := author3.text.get_text().find("merges"),
+                   final_pos + 6, color="red")
+    author2.format(final_pos, final_pos + 6, color="blue")  # later wins
+
     texts = {a.text.get_text() for a in (author1, author2, author3)}
     assert len(texts) == 1, f"replicas diverged: {texts}"
     final = texts.pop()
@@ -86,8 +117,15 @@ def main() -> int:
     print(f"title    : {author3.meta.get('title')}")
     print(f"text     : {final!r}")
     print(f"comment  : {note!r} on {commented!r} [{start}:{end}]")
+    runs = [(t, p) for t, p in author2.formatted_runs() if p]
+    for t, p in runs:
+        print(f"format   : {t!r} -> {p}")
     print(f"presence : {sorted(author1.presence.get_presences().values(), key=str)}")
     assert commented == "concurrent edits", commented
+    assert ("# Notes", {"bold": True}) in [(t.rstrip('\n'), p) for t, p in runs]
+    assert ("merges", {"color": "blue"}) in runs  # later annotate won
+    assert all(a.formatted_runs() == author2.formatted_runs()
+               for a in (author1, author3))
     print("converged: yes")
     return 0
 
