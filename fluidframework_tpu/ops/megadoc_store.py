@@ -138,3 +138,64 @@ class MegaDocStringStore(StringOpInterner):
     def slot_usage(self) -> np.ndarray:
         """(D, n_shards) active slot counts."""
         return np.asarray(self.state.count)
+
+    # ----------------------------------------------------- snapshot / resume
+
+    def snapshot(self) -> dict:
+        """Device→host gather of the sharded planes plus interning tables
+        (same recovery contract as TensorStringStore: restore + log-tail
+        replay through the same kernels)."""
+        st = self.state
+        return {
+            "planes": {k: np.asarray(getattr(st, k)).copy()
+                       for k in self.SNAP_PLANES},
+            "count": np.asarray(st.count).copy(),
+            "overflow": np.asarray(st.overflow).copy(),
+            "capacity_per_shard": self.capacity_per_shard,
+            "n_shards": self.mesh.devices.size,
+            "rebalance_headroom": self.rebalance_headroom,
+            "payloads": list(self._payloads),
+            "client_idx": [dict(m) for m in self._client_idx],
+            "prop_planes": dict(self._prop_planes),
+            "prop_values": self._prop_values.export(),
+            "has_props": self._has_props,
+        }
+
+    @classmethod
+    def restore(cls, snap: dict, mesh=None) -> "MegaDocStringStore":
+        """Rebuild on a mesh with the same device count (shard-local slot
+        runs re-upload exactly; a different-size mesh needs a rebalance
+        pass, not supported here)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from .megadoc_kernel import STATE_SPECS
+        from .merge_tree_kernel import StringState
+        n_docs = snap["count"].shape[0]
+        # skip __init__'s device allocation: the snapshot fully replaces it
+        store = cls.__new__(cls)
+        store.mesh = mesh if mesh is not None else make_megadoc_mesh()
+        if store.mesh.devices.size != snap["n_shards"]:
+            raise ValueError(
+                f"snapshot taken on {snap['n_shards']} shards; mesh has "
+                f"{store.mesh.devices.size}")
+        store.n_docs = n_docs
+        store.capacity_per_shard = snap["capacity_per_shard"]
+        store.rebalance_headroom = snap["rebalance_headroom"]
+        store.n_props = snap["planes"]["prop_val"].shape[2]
+        store._runs_cache = None
+        store._runs_state = None
+        arrays = dict(snap["planes"], count=snap["count"],
+                      overflow=snap["overflow"])
+        store.state = StringState(**{
+            k: jax.device_put(jnp.asarray(arrays[k]),
+                              NamedSharding(store.mesh, STATE_SPECS[k]))
+            for k in STATE_SPECS
+        })
+        store._payloads = [tuple(p) for p in snap["payloads"]]
+        store._client_idx = [dict(m) for m in snap["client_idx"]]
+        store._prop_planes = dict(snap["prop_planes"])
+        from .schema import ValueInterner
+        store._prop_values = ValueInterner.restore(snap["prop_values"])
+        store._has_props = snap["has_props"]
+        return store

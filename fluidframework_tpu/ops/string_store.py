@@ -34,6 +34,12 @@ class StringOpInterner:
     insert-with-props → insert + same-seq annotate expansion. One
     implementation so the two serving facades cannot drift apart."""
 
+    # every per-slot plane of StringState, derived so a future plane cannot
+    # be silently dropped from either store's snapshots
+    SNAP_PLANES = tuple(
+        f.name for f in dataclasses.fields(StringState)
+        if f.name not in ("count", "overflow"))
+
     def _init_interner(self, n_docs: int, n_props: int) -> None:
         self._payloads: List[Tuple[int, str]] = [(_TEXT, "")]  # handle 0
         self._client_idx: List[Dict[int, int]] = [dict()
@@ -229,11 +235,7 @@ class TensorStringStore(StringOpInterner):
 
     # ----------------------------------------------------- snapshot / resume
 
-    # every per-slot plane of StringState, derived so a future plane cannot
-    # be silently dropped from snapshots
-    _SNAP_PLANES = tuple(
-        f.name for f in dataclasses.fields(StringState)
-        if f.name not in ("count", "overflow"))
+    _SNAP_PLANES = StringOpInterner.SNAP_PLANES
 
     def snapshot(self) -> dict:
         """Device→host gather of the merged state plus the host interning

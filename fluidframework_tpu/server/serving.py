@@ -39,31 +39,67 @@ class StringServingEngine:
                  batch_window: int = 64, n_partitions: int = 8,
                  compact_every: int = 16,
                  log: Optional[PartitionedLog] = None,
-                 store: Optional[TensorStringStore] = None):
+                 store: Optional[TensorStringStore] = None,
+                 mega_docs: int = 0, mega_capacity_per_shard: int = 256,
+                 mega_store=None):
         self.deli = DeliSequencer()
         self.log = log if log is not None else PartitionedLog(n_partitions)
         self.store = store if store is not None \
             else TensorStringStore(n_docs, capacity, n_props)
+        # mega tier: documents too long for one chip's slot budget are
+        # served by the segment-axis-sharded store (declare with mark_mega
+        # BEFORE the doc's first op; capacity here is per shard per doc)
+        self.mega_store = mega_store
+        if mega_store is None and mega_docs > 0:
+            from ..ops.megadoc_store import MegaDocStringStore
+            self.mega_store = MegaDocStringStore(mega_docs,
+                                                 mega_capacity_per_shard)
         self.n_docs = n_docs
         self.batch_window = batch_window
         self.compact_every = compact_every
         self._doc_rows: Dict[str, int] = {}
+        self._mega_rows: Dict[str, int] = {}
         self._queue: List[Tuple[int, SequencedDocumentMessage]] = []
+        self._mega_queue: List[Tuple[int, SequencedDocumentMessage]] = []
         self._flushes_since_compact = 0
         self._min_seq: Dict[str, int] = {}
 
     # ------------------------------------------------------------ membership
 
     def doc_row(self, doc_id: str) -> int:
+        if doc_id in self._mega_rows:
+            return self._mega_rows[doc_id]
         if doc_id not in self._doc_rows:
             if len(self._doc_rows) >= self.n_docs:
                 raise KeyError(f"document capacity {self.n_docs} exhausted")
             self._doc_rows[doc_id] = len(self._doc_rows)
         return self._doc_rows[doc_id]
 
+    def mark_mega(self, doc_id: str) -> None:
+        """Route this document to the segment-axis-sharded mega tier (must
+        happen before its first op; requires mega_docs capacity). The mark
+        is appended to the durable log so recovery replays it before the
+        doc's ops — membership survives a crash between summaries."""
+        if self.mega_store is None:
+            raise ValueError("engine created without a mega tier")
+        if doc_id in self._doc_rows:
+            raise ValueError(f"{doc_id} already has ops on the flat tier")
+        if doc_id not in self._mega_rows:
+            self._register_mega(doc_id)
+            self._log_append(doc_id, SequencedDocumentMessage(
+                doc_id=doc_id, client_id=-1, client_seq=0, ref_seq=0,
+                seq=0, min_seq=0, type=MessageType.PROPOSAL,
+                contents={"markMega": True}))
+
+    def _register_mega(self, doc_id: str) -> None:
+        if len(self._mega_rows) >= self.mega_store.n_docs:
+            raise KeyError("mega-doc capacity exhausted")
+        self._mega_rows[doc_id] = len(self._mega_rows)
+
     def connect(self, doc_id: str, client_id: int
                 ) -> SequencedDocumentMessage:
-        self.doc_row(doc_id)
+        # row allocation is lazy (first op/read): a JOIN must not pin the
+        # doc to the flat tier before mark_mega can run
         msg = self.deli.client_join(doc_id, client_id)
         self._log_append(doc_id, msg)
         return msg
@@ -88,9 +124,13 @@ class StringServingEngine:
         if nack is not None:
             return None, nack
         self._log_append(doc_id, msg)
-        self._queue.append((self.doc_row(doc_id), msg))
+        row = self.doc_row(doc_id)
+        if doc_id in self._mega_rows:
+            self._mega_queue.append((row, msg))
+        else:
+            self._queue.append((row, msg))
         self._min_seq[doc_id] = msg.min_seq
-        if len(self._queue) >= self.batch_window:
+        if len(self._queue) + len(self._mega_queue) >= self.batch_window:
             self.flush()
         return msg, None
 
@@ -108,15 +148,18 @@ class StringServingEngine:
     # ----------------------------------------------------------- device side
 
     def flush(self) -> int:
-        """Merge the queued window on device in one batched apply."""
-        if not self._queue:
-            return 0
-        n = len(self._queue)
-        self.store.apply_messages(self._queue)
-        self._queue.clear()
-        self._flushes_since_compact += 1
-        if self._flushes_since_compact >= self.compact_every:
-            self.compact()
+        """Merge the queued window on device in one batched apply per tier."""
+        n = len(self._queue) + len(self._mega_queue)
+        if self._queue:
+            self.store.apply_messages(self._queue)
+            self._queue.clear()
+        if self._mega_queue:
+            self.mega_store.apply_messages(self._mega_queue)
+            self._mega_queue.clear()
+        if n:
+            self._flushes_since_compact += 1
+            if self._flushes_since_compact >= self.compact_every:
+                self.compact()
         return n
 
     def compact(self) -> None:
@@ -125,24 +168,41 @@ class StringServingEngine:
         for doc_id, row in self._doc_rows.items():
             min_seq[row] = self._min_seq.get(doc_id, 0)
         self.store.compact(min_seq)
+        if self.mega_store is not None and self._mega_rows:
+            ms = np.zeros((self.mega_store.n_docs,), np.int32)
+            for doc_id, row in self._mega_rows.items():
+                ms[row] = self._min_seq.get(doc_id, 0)
+            self.mega_store.compact(ms)
         self._flushes_since_compact = 0
 
     # ----------------------------------------------------------------- reads
 
+    def _store_of(self, doc_id: str):
+        if doc_id in self._mega_rows:
+            return self.mega_store, self._mega_rows[doc_id]
+        return self.store, self.doc_row(doc_id)
+
     def read_text(self, doc_id: str) -> str:
         self.flush()
-        return self.store.read_text(self._doc_rows[doc_id])
+        store, row = self._store_of(doc_id)
+        return store.read_text(row)
 
     def get_properties(self, doc_id: str, pos: int) -> dict:
         self.flush()
-        return self.store.get_properties(self._doc_rows[doc_id], pos)
+        store, row = self._store_of(doc_id)
+        return store.get_properties(row, pos)
 
     def overflowed_docs(self) -> List[str]:
         """Docs whose device capacity overflowed (ops dropped): these must
         be drained through the oracle and re-uploaded (the escape hatch of
         SURVEY.md §7 risk (b))."""
         flags = self.store.overflowed()
-        return [d for d, row in self._doc_rows.items() if flags[row]]
+        out = [d for d, row in self._doc_rows.items() if flags[row]]
+        if self.mega_store is not None and self._mega_rows:
+            mflags = self.mega_store.overflowed()
+            out += [d for d, row in self._mega_rows.items()
+                    if mflags[row].any()]
+        return out
 
     # ----------------------------------------------------- summary / recovery
 
@@ -153,10 +213,13 @@ class StringServingEngine:
         self.compact()
         return {
             "store": self.store.snapshot(),
+            "mega_store": self.mega_store.snapshot()
+            if self.mega_store is not None else None,
             "deli": self.deli.checkpoint(),
             "log_offsets": [self.log.size(p)
                             for p in range(self.log.n_partitions)],
             "doc_rows": dict(self._doc_rows),
+            "mega_rows": dict(self._mega_rows),
             "min_seq": dict(self._min_seq),
         }
 
@@ -168,10 +231,15 @@ class StringServingEngine:
         appended after the summary's offsets) through the same apply
         kernels — the single recovery primitive."""
         store = TensorStringStore.restore(summary["store"])
+        mega = None
+        if summary.get("mega_store") is not None:
+            from ..ops.megadoc_store import MegaDocStringStore
+            mega = MegaDocStringStore.restore(summary["mega_store"])
         engine = cls(store.n_docs, store.capacity, store.n_props,
-                     log=log, store=store, **kwargs)
+                     log=log, store=store, mega_store=mega, **kwargs)
         engine.deli = DeliSequencer.restore(summary["deli"])
         engine._doc_rows = dict(summary["doc_rows"])
+        engine._mega_rows = dict(summary.get("mega_rows", {}))
         engine._min_seq = dict(summary["min_seq"])
         # replay EVERY tail message through the sequencer state (so resumed
         # sequencing continues past the tail, not from the stale checkpoint);
@@ -180,12 +248,20 @@ class StringServingEngine:
         for p in range(log.n_partitions):
             for msg in log.read(p, from_offset=summary["log_offsets"][p]):
                 engine.deli.replay(msg)
-                if msg.type == MessageType.CLIENT_JOIN:
-                    engine.doc_row(msg.doc_id)
-                elif msg.type == MessageType.OP:
-                    engine._queue.append(
-                        (engine.doc_row(msg.doc_id), msg))
+                if msg.type == MessageType.PROPOSAL and \
+                        isinstance(msg.contents, dict) and \
+                        msg.contents.get("markMega"):
+                    if msg.doc_id not in engine._mega_rows:
+                        engine._register_mega(msg.doc_id)  # no re-log
+                    continue  # control record: not for the stores
+                if msg.type == MessageType.OP:
+                    row = engine.doc_row(msg.doc_id)
+                    if msg.doc_id in engine._mega_rows:
+                        engine._mega_queue.append((row, msg))
+                    else:
+                        engine._queue.append((row, msg))
                     engine._min_seq[msg.doc_id] = msg.min_seq
         engine._queue.sort(key=lambda dm: dm[1].seq)
+        engine._mega_queue.sort(key=lambda dm: dm[1].seq)
         engine.flush()
         return engine

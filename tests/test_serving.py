@@ -193,3 +193,105 @@ def test_engine_recovery_join_only_doc_in_tail():
     c.apply_msg(msg)
     assert engine2.read_text("newdoc") == "hello"
     assert engine2.read_text("old") == "x"
+
+
+def test_engine_mega_tier_routes_and_converges():
+    """Documents marked mega are served by the segment-axis-sharded store
+    with the same API and convergence as the flat tier."""
+    rng = random.Random(3)
+    engine = StringServingEngine(n_docs=1, capacity=256, batch_window=8,
+                                 mega_docs=1, mega_capacity_per_shard=64)
+    engine.mark_mega("huge")
+    docs = ["huge", "small"]
+    clients = _mk(engine, docs, 2)
+    inflight = {d: [] for d in docs}
+    _run_storm(engine, docs, clients, rng, 50, inflight)
+    _drain(docs, clients, inflight)
+    for d in docs:
+        texts = {c.get_text() for c in clients[d]}
+        assert len(texts) == 1
+        assert engine.read_text(d) == texts.pop(), d
+        oracle = clients[d][0]
+        for pos in range(oracle.get_length()):
+            seg, _ = oracle.tree.get_containing_segment(pos)
+            want = {k: v for k, v in seg.props.items() if v is not None}
+            assert engine.get_properties(d, pos) == want, (d, pos)
+
+
+def test_engine_mega_tier_summary_recovery():
+    rng = random.Random(9)
+    log = PartitionedLog(4)
+    engine = StringServingEngine(n_docs=1, capacity=256, batch_window=8,
+                                 mega_docs=1, mega_capacity_per_shard=64,
+                                 log=log)
+    engine.mark_mega("huge")
+    docs = ["huge", "small"]
+    clients = _mk(engine, docs, 2)
+    inflight = {d: [] for d in docs}
+    _run_storm(engine, docs, clients, rng, 30, inflight)
+    summary = engine.summarize()
+    _run_storm(engine, docs, clients, rng, 20, inflight)
+    _drain(docs, clients, inflight)
+    want = {d: engine.read_text(d) for d in docs}
+
+    engine2 = StringServingEngine.load(summary, log)
+    for d in docs:
+        assert engine2.read_text(d) == want[d], d
+    # post-recovery edits keep working on the mega tier
+    c = clients["huge"][0]
+    op = c.insert_text_local(0, "Z")
+    msg, nack = engine2.submit("huge", c.client_id, op["clientSeq"],
+                               c.last_processed_seq, op)
+    assert nack is None
+    for cc in clients["huge"]:
+        cc.apply_msg(msg)
+    assert engine2.read_text("huge") == clients["huge"][0].get_text()
+
+
+def test_engine_mega_mark_survives_crash_before_summary():
+    """A mark_mega issued after the last summary must be replayed from the
+    durable log, or tail ops route to the flat tier and overflow it."""
+    log = PartitionedLog(4)
+    engine = StringServingEngine(n_docs=1, capacity=16, batch_window=4,
+                                 mega_docs=1, mega_capacity_per_shard=64,
+                                 log=log)
+    engine.connect("old", 1)
+    c_old = SequenceClient(1)
+    op = c_old.insert_text_local(0, "x")
+    msg, _ = engine.submit("old", 1, op["clientSeq"], 0, op)
+    c_old.apply_msg(msg)
+    summary = engine.summarize()
+
+    # mark + heavy ops AFTER the summary: tail must replay onto the mega tier
+    engine.mark_mega("huge")
+    engine.connect("huge", 5)
+    c = SequenceClient(5)
+    for i in range(30):  # 30 inserts would overflow the 16-slot flat tier
+        op = c.insert_text_local(c.get_length(), f"t{i} ")
+        msg, nack = engine.submit("huge", 5, op["clientSeq"],
+                                  c.last_processed_seq, op)
+        assert nack is None
+        c.apply_msg(msg)
+
+    engine2 = StringServingEngine.load(summary, log)
+    assert engine2.read_text("huge") == c.get_text()
+    assert engine2.read_text("old") == "x"
+    assert "huge" in engine2._mega_rows
+    assert not engine2.overflowed_docs()
+    # membership keeps surviving a SECOND recovery from the same log
+    engine3 = StringServingEngine.load(engine2.summarize(), log)
+    assert engine3.read_text("huge") == c.get_text()
+
+
+def test_engine_mark_mega_after_connect_allowed():
+    """A JOIN must not pin the doc to the flat tier (rows are lazy)."""
+    engine = StringServingEngine(n_docs=1, capacity=64, mega_docs=1,
+                                 mega_capacity_per_shard=32)
+    engine.connect("d", 1)
+    engine.mark_mega("d")  # must not raise
+    c = SequenceClient(1)
+    op = c.insert_text_local(0, "hello")
+    msg, nack = engine.submit("d", 1, op["clientSeq"], 0, op)
+    assert nack is None
+    assert engine.read_text("d") == "hello"
+    assert "d" in engine._mega_rows and "d" not in engine._doc_rows
