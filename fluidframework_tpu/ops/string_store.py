@@ -132,14 +132,48 @@ class TensorStringStore(StringOpInterner):
         # movement saves ~35% HBM traffic on the hot path)
         self.state = StringState.create(n_docs, capacity, n_props)
         self._init_interner(n_docs, n_props)
+        # serving-side intervals: anchors are (handle_op, handle_off) POINTS
+        # — position-independent, stable under splits, tombstone-tolerant —
+        # so op application never touches them (reference: local references;
+        # the oracle's lazy-slide-at-resolve / re-anchor-at-zamboni split)
+        self._intervals: List[Dict[str, tuple]] = [dict()
+                                                   for _ in range(n_docs)]
+        self._interval_counter = 0
+        # highest collaboration-window floor seen per doc (anchor slides
+        # trigger at its advances, matching the oracle's zamboni timing)
+        self._iv_min_seq = np.zeros((self.n_docs,), np.int64)
 
     # ----------------------------------------------------------------- apply
 
     def apply_messages(self, messages) -> None:
         """messages: iterable of (doc, SequencedDocumentMessage) carrying
-        merge-tree op contents (the ``mt`` dicts of SequenceClient)."""
+        merge-tree op contents (the ``mt`` dicts of SequenceClient).
+
+        Documents holding intervals need anchor slides at the exact message
+        where min_seq crosses a tombstone (the oracle slides per message as
+        the window advances; sliding once per batch can pick a different
+        target — e.g. a segment that was live at the crossing but tombstoned
+        by batch end). The batch is split at each min_seq advance for such
+        docs; everything else takes the single-batch fast path."""
+        msgs = list(messages)
+        iv_docs = {d for d in range(self.n_docs) if self._intervals[d]}
+        if not iv_docs:
+            self._apply_batch(msgs)
+            return
+        group: list = []
+        for doc, msg in msgs:
+            group.append((doc, msg))
+            if doc in iv_docs and msg.min_seq > self._iv_min_seq[doc]:
+                self._apply_batch(group)
+                group = []
+                self._iv_min_seq[doc] = msg.min_seq
+                self._reanchor_for_compact(self._iv_min_seq, only_doc=doc)
+        if group:
+            self._apply_batch(group)
+
+    def _apply_batch(self, msgs) -> None:
         per_doc: Dict[int, list] = {}
-        for doc, msg in messages:
+        for doc, msg in msgs:
             recs = self._records_for(doc, msg)
             if recs:
                 per_doc.setdefault(doc, []).extend(recs)
@@ -178,6 +212,7 @@ class TensorStringStore(StringOpInterner):
         """Zamboni: free tombstones below the collaboration window."""
         ms = jnp.full((self.n_docs,), int(min_seq), jnp.int32) \
             if np.isscalar(min_seq) else jnp.asarray(min_seq, jnp.int32)
+        self._reanchor_for_compact(np.asarray(ms))
         self.state = compact_string_state(self.state, ms, self._has_props)
 
     # ----------------------------------------------------------------- reads
@@ -224,6 +259,122 @@ class TensorStringStore(StringOpInterner):
             at += length[i]
         raise IndexError(f"doc {doc}: position {pos} beyond length {at}")
 
+    # -------------------------------------------------------- intervals
+    # Anchored ranges over the served text (reference: IntervalCollection /
+    # SequenceInterval with SlideOnRemove endpoints).
+
+    def _doc_slots(self, doc: int):
+        """(handle_op, handle_off, length, live) of active slots, host-side."""
+        st = self.state
+        n = int(st.count[doc])
+        return (np.asarray(st.handle_op[doc][:n]),
+                np.asarray(st.handle_off[doc][:n]),
+                np.asarray(st.length[doc][:n]),
+                np.asarray(st.removed_seq[doc][:n]) == NOT_REMOVED)
+
+    def _anchor_at(self, doc: int, pos: int):
+        """Anchor of the visible character at pos (doc end → last visible
+        char; empty doc → detached None), mirroring the oracle's _anchor."""
+        hop, hoff, length, live = self._doc_slots(doc)
+        at = 0
+        last = None
+        for i in range(len(hop)):
+            if not live[i]:
+                continue
+            if at <= pos < at + length[i]:
+                return (int(hop[i]), int(hoff[i]) + (pos - at))
+            at += length[i]
+            last = (int(hop[i]), int(hoff[i]) + int(length[i]) - 1)
+        return last  # pos at/after doc end → last char; None if empty
+
+    def _anchor_position(self, doc: int, anchor) -> int:
+        """Resolve an anchor with SLIDE semantics: a tombstoned anchor
+        resolves to the nearest following live position (the live prefix at
+        its slot), like the oracle's get_position."""
+        if anchor is None:
+            return 0  # detached parks at document start
+        h, off = anchor
+        hop, hoff, length, live = self._doc_slots(doc)
+        at = 0
+        for i in range(len(hop)):
+            if hop[i] == h and hoff[i] <= off < hoff[i] + length[i]:
+                return at + (off - int(hoff[i])) if live[i] else at
+            if live[i]:
+                at += length[i]
+        return at  # anchor's slot gone (shouldn't outlive compact re-anchor)
+
+    def add_interval(self, doc: int, start: int, end: int,
+                     props: Optional[dict] = None) -> str:
+        self._interval_counter += 1
+        iid = f"iv{self._interval_counter}"
+        self._intervals[doc][iid] = (self._anchor_at(doc, start),
+                                     self._anchor_at(doc, end),
+                                     dict(props or {}))
+        return iid
+
+    def remove_interval(self, doc: int, iid: str) -> None:
+        del self._intervals[doc][iid]
+
+    def interval_endpoints(self, doc: int, iid: str):
+        a, b, _props = self._intervals[doc][iid]
+        return (self._anchor_position(doc, a), self._anchor_position(doc, b))
+
+    def intervals(self, doc: int) -> dict:
+        return {iid: (*self.interval_endpoints(doc, iid), dict(props))
+                for iid, (_a, _b, props) in self._intervals[doc].items()}
+
+    def advance_min_seq(self, doc: int, min_seq: int) -> None:
+        """Window-floor advance that arrived outside the op stream (NOOP
+        heartbeats at the serving engine): slide this doc's anchors now, at
+        the crossing, exactly as an in-stream advance would."""
+        if self._intervals[doc] and min_seq > self._iv_min_seq[doc]:
+            self._iv_min_seq[doc] = min_seq
+            self._reanchor_for_compact(self._iv_min_seq, only_doc=doc)
+
+    def _reanchor_for_compact(self, min_seq: np.ndarray,
+                              only_doc: Optional[int] = None) -> None:
+        """Before zamboni drops tombstones at or below min_seq, move anchors
+        off doomed slots: to the first following live char, else the last
+        preceding live char, else detach (oracle _slide_refs rules)."""
+        docs = range(self.n_docs) if only_doc is None else (only_doc,)
+        for doc in docs:
+            if not self._intervals[doc]:
+                continue
+            st = self.state
+            n = int(st.count[doc])
+            removed = np.asarray(st.removed_seq[doc][:n])
+            doomed_mask = removed <= min_seq[doc]
+            if not doomed_mask.any():
+                continue
+            hop, hoff, length, live = self._doc_slots(doc)
+
+            def locate(off_h):
+                h, off = off_h
+                for i in range(n):
+                    if hop[i] == h and hoff[i] <= off < hoff[i] + length[i]:
+                        return i
+                return None
+
+            def slide(i):
+                for j in range(i + 1, n):
+                    if live[j]:
+                        return (int(hop[j]), int(hoff[j]))
+                for j in range(i - 1, -1, -1):
+                    if live[j]:
+                        return (int(hop[j]),
+                                int(hoff[j]) + int(length[j]) - 1)
+                return None
+
+            for iid, (a, b, props) in list(self._intervals[doc].items()):
+                new = []
+                for anchor in (a, b):
+                    if anchor is not None:
+                        i = locate(anchor)
+                        if i is not None and doomed_mask[i]:
+                            anchor = slide(i)
+                    new.append(anchor)
+                self._intervals[doc][iid] = (new[0], new[1], props)
+
     def overflowed(self) -> np.ndarray:
         return np.asarray(self.state.overflow)
 
@@ -258,6 +409,12 @@ class TensorStringStore(StringOpInterner):
             "prop_planes": dict(self._prop_planes),
             "prop_values": self._prop_values.export(),
             "has_props": self._has_props,
+            "intervals": [{iid: [list(a) if a else None,
+                                 list(b) if b else None, props]
+                           for iid, (a, b, props) in per_doc.items()}
+                          for per_doc in self._intervals],
+            "interval_counter": self._interval_counter,
+            "iv_min_seq": self._iv_min_seq.tolist(),
         }
 
     @classmethod
@@ -287,4 +444,13 @@ class TensorStringStore(StringOpInterner):
         store._prop_planes = dict(snap["prop_planes"])
         store._prop_values = ValueInterner.restore(snap["prop_values"])
         store._has_props = snap["has_props"]
+        store._intervals = [
+            {iid: (tuple(a) if a else None, tuple(b) if b else None,
+                   dict(props))
+             for iid, (a, b, props) in per_doc.items()}
+            for per_doc in snap.get("intervals",
+                                    [{} for _ in range(n_docs)])]
+        store._interval_counter = snap.get("interval_counter", 0)
+        store._iv_min_seq = np.asarray(
+            snap.get("iv_min_seq", [0] * n_docs), np.int64)
         return store

@@ -141,6 +141,12 @@ class StringServingEngine:
             doc_id, client_id, 0, ref_seq, MessageType.NOOP, None)
         if msg is not None:
             self._min_seq[doc_id] = msg.min_seq
+            # a heartbeat-only MSN advance must still slide interval anchors
+            # at the crossing (the op stream won't carry this advance)
+            store, row = self._store_of(doc_id)
+            if getattr(store, "_intervals", None) and store._intervals[row]:
+                self.flush()
+                store.advance_min_seq(row, msg.min_seq)
 
     def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
         self.log.append(partition_of(doc_id, self.log.n_partitions), msg)

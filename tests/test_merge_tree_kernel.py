@@ -185,3 +185,95 @@ def test_kernel_annotate_survives_compaction():
         seg, _ = oracle.tree.get_containing_segment(pos)
         want = {k: v for k, v in seg.props.items() if v is not None}
         assert store.get_properties(0, pos) == want, f"pos {pos}"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_store_intervals_match_oracle(seed):
+    """Serving-side intervals (handle anchors, lazy slide, re-anchor at
+    zamboni) must track the oracle IntervalCollection's endpoints through
+    edit storms that remove anchor text."""
+    from fluidframework_tpu.models.merge_tree import LOCAL_VIEW
+    from fluidframework_tpu.models.interval_collection import (
+        IntervalCollection,
+    )
+    rng = random.Random(seed)
+    # phase 1: build a document
+    text, length, msgs, clients = collab_stream(
+        seed, n_rounds=10, return_clients=True)
+    store = TensorStringStore(n_docs=1, capacity=1024)
+    store.apply_messages((0, m) for m in msgs)
+    oracle = clients[0]
+    coll = IntervalCollection("c", oracle.tree)
+
+    # anchors at random converged positions
+    ivs = []
+    for i in range(6):
+        if length < 2:
+            break
+        s = rng.randrange(length - 1)
+        e = rng.randint(s + 1, length - 1)
+        coll.apply_add(f"iv{i}", s, e, {}, LOCAL_VIEW, oracle.client_id)
+        ivs.append((f"iv{i}", store.add_interval(0, s, e)))
+
+    def check(stage):
+        for oid, sid in ivs:
+            want = coll.endpoints(coll.get(oid))
+            got = store.interval_endpoints(0, sid)
+            assert got == want, (stage, oid, got, want)
+
+    check("initial")
+
+    # phase 2: more edits (removes cross the anchors), same stream to both
+    from fluidframework_tpu.testing.mocks import MockSequencer
+    seqr = MockSequencer()
+    seqr.seq = max(m.seq for m in msgs)
+    for c in clients:
+        seqr.connect(c)
+    more = []
+    orig = seqr.process_one
+
+    def capture():
+        m = orig()
+        if m is not None and m.type == MessageType.OP:
+            more.append(m)
+        return m
+    seqr.process_one = capture
+    for _ in range(40):
+        c = rng.choice(clients)
+        n = c.get_length()
+        if n == 0 or rng.random() < 0.5:
+            seqr.submit(c, c.insert_text_local(rng.randint(0, n),
+                                               _rand_text(rng)))
+        else:
+            s = rng.randrange(n)
+            seqr.submit(c, c.remove_range_local(
+                s, rng.randint(s + 1, min(n, s + 8))))
+        seqr.process_some(rng.randint(0, seqr.outstanding))
+    seqr.process_all_messages()
+    store.apply_messages((0, m) for m in more)
+    check("after storm")
+
+    # phase 3: close the window — zamboni both sides, anchors must slide
+    # identically off the dropped tombstones
+    max_seq = max(m.seq for m in more) if more else seqr.seq
+    oracle.tree.zamboni(max_seq)
+    store.compact(max_seq)
+    check("after zamboni")
+    assert store.read_text(0) == oracle.get_text()
+
+
+def test_store_interval_snapshot_roundtrip():
+    """Interval anchors, ids, and the window floor must survive
+    snapshot/restore (the Summarizer resume path)."""
+    text, length, msgs, _ = collab_stream(4, return_clients=True)
+    store = TensorStringStore(1, 512)
+    store.apply_messages((0, m) for m in msgs)
+    iid = store.add_interval(0, 2, min(9, length - 1), {"note": "keep"})
+    before = store.interval_endpoints(0, iid)
+    restored = TensorStringStore.restore(store.snapshot())
+    assert restored.interval_endpoints(0, iid) == before
+    assert restored.intervals(0)[iid][2] == {"note": "keep"}
+    assert (restored._iv_min_seq == store._iv_min_seq).all()
+    # a fresh interval id allocated after restore must not collide
+    iid2 = restored.add_interval(0, 0, 1)
+    assert iid2 != iid
