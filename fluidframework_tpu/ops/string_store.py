@@ -12,6 +12,7 @@ bitmask.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -142,6 +143,13 @@ class TensorStringStore(StringOpInterner):
         # highest collaboration-window floor seen per doc (anchor slides
         # trigger at its advances, matching the oracle's zamboni timing)
         self._iv_min_seq = np.zeros((self.n_docs,), np.int64)
+        # per-doc min-heap of uncompacted tombstone seqs, maintained ONLY
+        # for interval-holding docs (seeded from the device planes when a
+        # doc gains its first interval; pushed per remove; pruned as the
+        # floor passes). Lets the apply path tell host-side whether a
+        # window-floor advance actually dooms a tombstone — only then do
+        # interval anchors need sliding at the crossing.
+        self._iv_tombs: List[list] = [[] for _ in range(n_docs)]
 
     # ----------------------------------------------------------------- apply
 
@@ -153,8 +161,11 @@ class TensorStringStore(StringOpInterner):
         where min_seq crosses a tombstone (the oracle slides per message as
         the window advances; sliding once per batch can pick a different
         target — e.g. a segment that was live at the crossing but tombstoned
-        by batch end). The batch is split at each min_seq advance for such
-        docs; everything else takes the single-batch fast path."""
+        by batch end). The batch is split at such a crossing — and only
+        there: the per-doc tombstone-seq heap tells us host-side whether an
+        advance dooms anything, so interval-holding docs in an active
+        collaboration (where MSN advances on nearly every message) still
+        take large batched dispatches."""
         msgs = list(messages)
         iv_docs = {d for d in range(self.n_docs) if self._intervals[d]}
         if not iv_docs:
@@ -163,11 +174,15 @@ class TensorStringStore(StringOpInterner):
         group: list = []
         for doc, msg in msgs:
             group.append((doc, msg))
-            if doc in iv_docs and msg.min_seq > self._iv_min_seq[doc]:
-                self._apply_batch(group)
-                group = []
-                self._iv_min_seq[doc] = msg.min_seq
-                self._reanchor_for_compact(self._iv_min_seq, only_doc=doc)
+            if doc in iv_docs:
+                if msg.min_seq > self._iv_min_seq[doc]:
+                    self._iv_min_seq[doc] = msg.min_seq
+                    if self._floor_dooms_tombstone(doc):
+                        self._apply_batch(group)
+                        group = []
+                        self._slide_anchors_at_floor(doc)
+                if msg.contents["mt"] == "remove":
+                    heapq.heappush(self._iv_tombs[doc], msg.seq)
         if group:
             self._apply_batch(group)
 
@@ -212,8 +227,11 @@ class TensorStringStore(StringOpInterner):
         """Zamboni: free tombstones below the collaboration window."""
         ms = jnp.full((self.n_docs,), int(min_seq), jnp.int32) \
             if np.isscalar(min_seq) else jnp.asarray(min_seq, jnp.int32)
-        self._reanchor_for_compact(np.asarray(ms))
+        ms_host = np.asarray(ms)
+        self._reanchor_for_compact(ms_host)
         self.state = compact_string_state(self.state, ms, self._has_props)
+        for doc in range(self.n_docs):
+            self._prune_tombs(doc, int(ms_host[doc]))
 
     # ----------------------------------------------------------------- reads
 
@@ -287,14 +305,16 @@ class TensorStringStore(StringOpInterner):
             last = (int(hop[i]), int(hoff[i]) + int(length[i]) - 1)
         return last  # pos at/after doc end → last char; None if empty
 
-    def _anchor_position(self, doc: int, anchor) -> int:
+    def _anchor_position(self, doc: int, anchor, slots=None) -> int:
         """Resolve an anchor with SLIDE semantics: a tombstoned anchor
         resolves to the nearest following live position (the live prefix at
-        its slot), like the oracle's get_position."""
+        its slot), like the oracle's get_position. ``slots`` lets a caller
+        resolving many anchors fetch the doc's planes once."""
         if anchor is None:
             return 0  # detached parks at document start
         h, off = anchor
-        hop, hoff, length, live = self._doc_slots(doc)
+        hop, hoff, length, live = slots if slots is not None \
+            else self._doc_slots(doc)
         at = 0
         for i in range(len(hop)):
             if hop[i] == h and hoff[i] <= off < hoff[i] + length[i]:
@@ -303,8 +323,41 @@ class TensorStringStore(StringOpInterner):
                 at += length[i]
         return at  # anchor's slot gone (shouldn't outlive compact re-anchor)
 
+    def _floor_dooms_tombstone(self, doc: int) -> bool:
+        """Does the current window floor reach a pending tombstone (so
+        anchors must slide before more ops land)?"""
+        tombs = self._iv_tombs[doc]
+        return bool(tombs) and tombs[0] <= self._iv_min_seq[doc]
+
+    def _slide_anchors_at_floor(self, doc: int) -> None:
+        """Slide anchors off slots doomed by the current floor, then drop
+        those tombstones from the heap (an already-slid tombstone never
+        needs another slide)."""
+        self._reanchor_for_compact(self._iv_min_seq, only_doc=doc)
+        self._prune_tombs(doc, int(self._iv_min_seq[doc]))
+
+    def _prune_tombs(self, doc: int, floor: int) -> None:
+        tombs = self._iv_tombs[doc]
+        while tombs and tombs[0] <= floor:
+            heapq.heappop(tombs)
+
+    def _seed_tombs(self, doc: int) -> None:
+        """Rebuild the doc's tombstone heap from the device planes (on the
+        first interval, or after restore): any resident removed_seq above
+        the floor is a tombstone a future floor advance could doom."""
+        st = self.state
+        n = int(st.count[doc])
+        removed = np.asarray(st.removed_seq[doc][:n])
+        floor = self._iv_min_seq[doc]
+        tombs = [int(s) for s in removed[removed != NOT_REMOVED]
+                 if s > floor]
+        heapq.heapify(tombs)
+        self._iv_tombs[doc] = tombs
+
     def add_interval(self, doc: int, start: int, end: int,
                      props: Optional[dict] = None) -> str:
+        if not self._intervals[doc]:
+            self._seed_tombs(doc)  # bookkeeping starts at the first interval
         self._interval_counter += 1
         iid = f"iv{self._interval_counter}"
         self._intervals[doc][iid] = (self._anchor_at(doc, start),
@@ -317,19 +370,25 @@ class TensorStringStore(StringOpInterner):
 
     def interval_endpoints(self, doc: int, iid: str):
         a, b, _props = self._intervals[doc][iid]
-        return (self._anchor_position(doc, a), self._anchor_position(doc, b))
+        slots = self._doc_slots(doc)
+        return (self._anchor_position(doc, a, slots),
+                self._anchor_position(doc, b, slots))
 
     def intervals(self, doc: int) -> dict:
-        return {iid: (*self.interval_endpoints(doc, iid), dict(props))
-                for iid, (_a, _b, props) in self._intervals[doc].items()}
+        slots = self._doc_slots(doc)
+        return {iid: (self._anchor_position(doc, a, slots),
+                      self._anchor_position(doc, b, slots), dict(props))
+                for iid, (a, b, props) in self._intervals[doc].items()}
 
     def advance_min_seq(self, doc: int, min_seq: int) -> None:
         """Window-floor advance that arrived outside the op stream (NOOP
         heartbeats at the serving engine): slide this doc's anchors now, at
         the crossing, exactly as an in-stream advance would."""
-        if self._intervals[doc] and min_seq > self._iv_min_seq[doc]:
-            self._iv_min_seq[doc] = min_seq
-            self._reanchor_for_compact(self._iv_min_seq, only_doc=doc)
+        if not self._intervals[doc] or min_seq <= self._iv_min_seq[doc]:
+            return
+        self._iv_min_seq[doc] = min_seq
+        if self._floor_dooms_tombstone(doc):
+            self._slide_anchors_at_floor(doc)
 
     def _reanchor_for_compact(self, min_seq: np.ndarray,
                               only_doc: Optional[int] = None) -> None:
@@ -346,7 +405,10 @@ class TensorStringStore(StringOpInterner):
             doomed_mask = removed <= min_seq[doc]
             if not doomed_mask.any():
                 continue
-            hop, hoff, length, live = self._doc_slots(doc)
+            hop = np.asarray(st.handle_op[doc][:n])
+            hoff = np.asarray(st.handle_off[doc][:n])
+            length = np.asarray(st.length[doc][:n])
+            live = removed == NOT_REMOVED
 
             def locate(off_h):
                 h, off = off_h
@@ -453,4 +515,8 @@ class TensorStringStore(StringOpInterner):
         store._interval_counter = snap.get("interval_counter", 0)
         store._iv_min_seq = np.asarray(
             snap.get("iv_min_seq", [0] * n_docs), np.int64)
+        store._iv_tombs = [[] for _ in range(n_docs)]
+        for d in range(n_docs):
+            if store._intervals[d]:
+                store._seed_tombs(d)
         return store

@@ -142,11 +142,16 @@ class StringServingEngine:
         if msg is not None:
             self._min_seq[doc_id] = msg.min_seq
             # a heartbeat-only MSN advance must still slide interval anchors
-            # at the crossing (the op stream won't carry this advance)
-            store, row = self._store_of(doc_id)
-            if getattr(store, "_intervals", None) and store._intervals[row]:
-                self.flush()
-                store.advance_min_seq(row, msg.min_seq)
+            # at the crossing (the op stream won't carry this advance).
+            # Only docs that already hold a row can have intervals — looking
+            # one up via _store_of would lazily allocate a flat-tier row and
+            # wrongly pin a heartbeat-only doc (breaking a later mark_mega).
+            if doc_id in self._doc_rows or doc_id in self._mega_rows:
+                store, row = self._store_of(doc_id)
+                if getattr(store, "_intervals", None) \
+                        and store._intervals[row]:
+                    self.flush()
+                    store.advance_min_seq(row, msg.min_seq)
 
     def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
         self.log.append(partition_of(doc_id, self.log.n_partitions), msg)

@@ -76,3 +76,68 @@ def test_zamboni_slide_with_coalesce_in_same_pass():
     d1 = a.get_interval_collection("c").digest()
     d2 = b.get_interval_collection("c").digest()
     assert d1 == d2
+
+
+def test_heartbeat_does_not_pin_doc_to_flat_tier():
+    """A heartbeat-only doc must not allocate a flat-tier row: that would
+    break a later mark_mega and consume capacity for docs that never carry
+    an op (confirmed review repro)."""
+    from fluidframework_tpu.server.serving import StringServingEngine
+    engine = StringServingEngine(n_docs=1, capacity=64, mega_docs=1,
+                                 mega_capacity_per_shard=32)
+    engine.connect("bigdoc", 1)
+    engine.heartbeat("bigdoc", 1, 0)
+    engine.mark_mega("bigdoc")  # must not raise
+    assert "bigdoc" not in engine._doc_rows
+    # heartbeat-only docs also must not exhaust flat capacity (n_docs=1)
+    engine.connect("idle", 2)
+    engine.heartbeat("idle", 2, 0)
+    assert "idle" not in engine._doc_rows
+    engine.connect("real", 3)
+    from fluidframework_tpu.models.merge_tree_client import SequenceClient
+    c = SequenceClient(3)
+    op = c.insert_text_local(0, "hi")
+    msg, nack = engine.submit("real", 3, op["clientSeq"], 0, op)
+    assert nack is None
+    assert engine.read_text("real") == "hi"
+
+
+def test_interval_docs_stay_batched_until_tombstone_crossing():
+    """min_seq advances on an interval-holding doc must NOT split the
+    batched dispatch unless the advance actually dooms a tombstone
+    (review finding: per-message dispatches in active collaborations)."""
+    from fluidframework_tpu.core.protocol import SequencedDocumentMessage
+    from fluidframework_tpu.ops.string_store import TensorStringStore
+
+    def mk(seq, min_seq, contents):
+        return SequencedDocumentMessage(
+            doc_id="d", client_id=1, client_seq=seq, ref_seq=seq - 1,
+            seq=seq, min_seq=min_seq, type=MessageType.OP,
+            contents=contents)
+
+    store = TensorStringStore(1, capacity=256)
+    store.apply_messages(
+        [(0, mk(1, 0, {"mt": "insert", "kind": 0, "pos": 0,
+                       "text": "hello world"}))])
+    store.add_interval(0, 2, 7)
+
+    batches = []
+    orig = store._apply_batch
+    store._apply_batch = lambda g: (batches.append(len(g)), orig(g))[1]
+
+    # insert-only storm, MSN advancing on every message: one dispatch
+    stream = [(0, mk(s, s - 1, {"mt": "insert", "kind": 0, "pos": 0,
+                                "text": "x"}))
+              for s in range(2, 18)]
+    store.apply_messages(stream)
+    assert batches == [len(stream)]
+
+    # a remove followed by the MSN crossing it: exactly one split
+    batches.clear()
+    stream2 = [(0, mk(18, 16, {"mt": "remove", "start": 0, "end": 2}))]
+    stream2 += [(0, mk(s, 17, {"mt": "insert", "kind": 0, "pos": 0,
+                               "text": "y"})) for s in (19, 20)]
+    stream2 += [(0, mk(s, 19, {"mt": "insert", "kind": 0, "pos": 0,
+                               "text": "z"})) for s in (21, 22)]
+    store.apply_messages(stream2)
+    assert len(batches) == 2  # split once, at the min_seq=19>=18 crossing
