@@ -8,6 +8,11 @@ from .data_object import (
 )
 from .fluid_static import FluidContainer, LocalClient, ServiceClient
 from .presence import PresenceManager
+from .undo_redo import (
+    SharedMapUndoRedoHandler,
+    SharedSegmentSequenceUndoRedoHandler,
+    UndoRedoStackManager,
+)
 
 __all__ = [
     "ContainerRuntimeFactoryWithDefaultDataObject",
@@ -17,4 +22,7 @@ __all__ = [
     "LocalClient",
     "ServiceClient",
     "PresenceManager",
+    "SharedMapUndoRedoHandler",
+    "SharedSegmentSequenceUndoRedoHandler",
+    "UndoRedoStackManager",
 ]

@@ -1,0 +1,259 @@
+"""Undo-redo: revertible stacks over DDS delta events.
+
+Reference counterpart: ``@fluidframework/undo-redo`` (SURVEY.md's component
+inventory misses it; upstream ships ``UndoRedoStackManager``,
+``SharedMapUndoRedoHandler``, ``SharedSegmentSequenceUndoRedoHandler``).
+The mechanism is the reference's: handlers subscribe to DDS events
+("valueChanged"/"clear" on maps, "sequenceDelta" on sequences), turn each
+LOCAL delta into a revertible, and group revertibles into operations on an
+undo stack. A revert is an ordinary local op — it flows through the
+sequencer like any edit, so undo converges across replicas by construction.
+Reverting while undoing routes the new revertibles to the redo stack (and
+vice versa); a fresh user edit clears redo.
+
+Sequence revertibles hold their segments through a merge-tree
+``TrackingGroup``: splits keep both halves tracked and zamboni spares
+tracked tombstones, so "undo my remove" can restore the exact text+props
+even after the collaboration window moved past the tombstone. Annotate
+revertibles carry the previous property values per tracked span and match
+split descendants by payload handle interval.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..models.merge_tree import SegmentKind, TrackingGroup
+from ..models.shared_map import NO_VALUE
+
+_NORMAL, _UNDO, _REDO = "normal", "undo", "redo"
+
+
+class UndoRedoStackManager:
+    """Groups revertibles into operations; undo/redo replays them.
+
+    Reference: ``UndoRedoStackManager`` — operations accumulate until
+    ``close_current_operation`` (callers close per user gesture); ``undo``
+    reverts the newest operation's revertibles in reverse order.
+    """
+
+    def __init__(self):
+        self._undo: List[List] = []
+        self._redo: List[List] = []
+        self._open: Optional[List] = None
+        self._mode = _NORMAL
+
+    # ------------------------------------------------------------ collecting
+
+    def push_to_current_operation(self, revertible) -> None:
+        if self._mode == _NORMAL:
+            for op in self._redo:
+                for rev in op:
+                    rev.discard()
+            self._redo.clear()
+        if self._open is None:
+            self._open = []
+        self._open.append(revertible)
+
+    def close_current_operation(self) -> None:
+        if self._open:
+            target = self._redo if self._mode == _UNDO else self._undo
+            target.append(self._open)
+        self._open = None
+
+    @property
+    def undo_stack_size(self) -> int:
+        return len(self._undo) + (1 if self._open else 0)
+
+    @property
+    def redo_stack_size(self) -> int:
+        return len(self._redo)
+
+    # -------------------------------------------------------------- replaying
+
+    def undo_operation(self) -> bool:
+        """Revert the newest operation. Returns False if nothing to undo."""
+        self.close_current_operation()
+        if not self._undo:
+            return False
+        operation = self._undo.pop()
+        self._mode = _UNDO
+        try:
+            for rev in reversed(operation):
+                rev.revert()
+        finally:
+            self.close_current_operation()  # reverts' revertibles → redo
+            self._mode = _NORMAL
+        return True
+
+    def redo_operation(self) -> bool:
+        self.close_current_operation()
+        if not self._redo:
+            return False
+        operation = self._redo.pop()
+        self._mode = _REDO
+        try:
+            for rev in reversed(operation):
+                rev.revert()
+        finally:
+            self.close_current_operation()  # in redo mode → undo stack
+            self._mode = _NORMAL
+        return True
+
+
+# --------------------------------------------------------------------- map
+
+
+class SharedMapKeyRevertible:
+    """Revert one key change: restore the previous value. ``NO_VALUE``
+    means the key was absent, so revert deletes it — a stored ``None`` is
+    a legal value here (unlike JS ``undefined``) and restores as ``None``."""
+
+    def __init__(self, smap, key: str, previous: Any):
+        self.map, self.key, self.previous = smap, key, previous
+
+    def revert(self) -> None:
+        if self.previous is NO_VALUE:
+            if self.map.has(self.key):
+                self.map.delete(self.key)
+        else:
+            self.map.set(self.key, self.previous)
+
+    def discard(self) -> None:
+        pass
+
+
+class SharedMapClearRevertible:
+    def __init__(self, smap, previous: Dict[str, Any]):
+        self.map, self.previous = smap, dict(previous)
+
+    def revert(self) -> None:
+        for key, value in self.previous.items():
+            self.map.set(key, value)
+
+    def discard(self) -> None:
+        pass
+
+
+class SharedMapUndoRedoHandler:
+    """Reference: ``SharedMapUndoRedoHandler.attachMap``."""
+
+    def __init__(self, stack: UndoRedoStackManager):
+        self.stack = stack
+        self._subs: List[Tuple[Any, str, Any]] = []
+
+    def attach(self, smap) -> None:
+        self._subs.append((smap, "valueChanged",
+                           smap.on("valueChanged", self._value_changed)))
+        self._subs.append((smap, "clear", smap.on("clear", self._cleared)))
+
+    def detach(self) -> None:
+        for obj, event, listener in self._subs:
+            obj.off(event, listener)
+        self._subs.clear()
+
+    def _value_changed(self, smap, key, previous, local) -> None:
+        if local:
+            self.stack.push_to_current_operation(
+                SharedMapKeyRevertible(smap, key, previous))
+
+    def _cleared(self, smap, previous, local) -> None:
+        if local:
+            self.stack.push_to_current_operation(
+                SharedMapClearRevertible(smap, previous))
+
+
+# ---------------------------------------------------------------- sequence
+
+
+class SharedSegmentSequenceRevertible:
+    """Revert one sequence delta via its tracked segments.
+
+    insert → remove each tracked segment still live at its current position;
+    remove → re-insert each tracked tombstone's text+props at its slid
+    position; annotate → restore each tracked live segment's previous
+    property values. Reference: ``SharedSegmentSequenceRevertible`` over
+    merge-tree tracking groups.
+    """
+
+    def __init__(self, shared_string, delta: dict):
+        self.ss = shared_string
+        self.operation = delta["operation"]
+        self.group = TrackingGroup()
+        for seg in delta["segments"]:
+            self.group.link(seg)
+        # annotate: previous values ride as tracking-group meta, which the
+        # merge tree copies to split halves and reverts migrate on replace —
+        # so a descendant of the annotated segment still finds its values
+        for seg, prev in delta.get("previous_properties", []):
+            self.group.meta[id(seg)] = prev
+
+    def _previous_for(self, seg) -> Optional[dict]:
+        return self.group.meta.get(id(seg))
+
+    def revert(self) -> None:
+        tree = self.ss.tree
+        order = {id(s): i for i, s in enumerate(tree.segments)}
+        segs = sorted((s for s in self.group.segments if id(s) in order),
+                      key=lambda s: order[id(s)])
+        if self.operation == "insert":
+            # reverse order: each removal shifts later positions left
+            for seg in reversed(segs):
+                if seg.removed_seq is None:
+                    pos = tree.get_position(seg)
+                    self.ss.remove_text(pos, pos + seg.length)
+        elif self.operation == "remove":
+            # forward order: each tombstone re-inserts at its slid position,
+            # landing before the next tombstone's slide target
+            for seg in segs:
+                if seg.removed_seq is not None:
+                    pos = tree.get_position(seg)
+                    props = dict(seg.props) or None
+                    if seg.kind == SegmentKind.MARKER:
+                        self.ss.insert_marker(pos, props)
+                    else:
+                        self.ss.insert_text(pos, seg.text, props)
+                    # the restored segment IS this content as far as other
+                    # revertibles are concerned: transfer the tombstone's
+                    # other tracking-group memberships to it (reference
+                    # behavior — lets a later "undo the original insert"
+                    # remove restored copies too)
+                    replacement = self.ss.last_delta["segments"][0]
+                    for tg in list(seg.tracking):
+                        if tg is not self.group:
+                            tg.replace(seg, replacement)
+        else:  # annotate
+            for seg in segs:
+                if seg.removed_seq is None:
+                    previous = self._previous_for(seg)
+                    if previous:
+                        pos = tree.get_position(seg)
+                        self.ss.annotate_range(pos, pos + seg.length,
+                                               dict(previous))
+        self.discard()
+
+    def discard(self) -> None:
+        self.group.clear()
+
+
+class SharedSegmentSequenceUndoRedoHandler:
+    """Reference: ``SharedSegmentSequenceUndoRedoHandler.attachSequence``."""
+
+    def __init__(self, stack: UndoRedoStackManager):
+        self.stack = stack
+        self._subs: List[Tuple[Any, str, Any]] = []
+
+    def attach(self, shared_string) -> None:
+        self._subs.append(
+            (shared_string, "sequenceDelta",
+             shared_string.on("sequenceDelta", self._sequence_delta)))
+
+    def detach(self) -> None:
+        for obj, event, listener in self._subs:
+            obj.off(event, listener)
+        self._subs.clear()
+
+    def _sequence_delta(self, shared_string, delta, local) -> None:
+        if local:
+            self.stack.push_to_current_operation(
+                SharedSegmentSequenceRevertible(shared_string, delta))

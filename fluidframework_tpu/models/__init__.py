@@ -8,6 +8,7 @@ These are the executable specification for the batched device kernels in
 
 from .merge_tree import (
     MergeTree, Segment, SegmentKind, SlidePolicy, LocalReference, LOCAL_VIEW,
+    TrackingGroup,
 )
 from .merge_tree_client import SequenceClient
 from .shared_object import (
@@ -29,4 +30,5 @@ __all__ = [
     "MapKernel", "SharedString", "SharedMatrix", "IntervalCollection",
     "SequenceInterval", "SharedCounter", "SharedCell", "RegisterCollection",
     "ConsensusQueue", "TaskManager", "SharedTree", "TreeSchema",
+    "TrackingGroup",
 ]

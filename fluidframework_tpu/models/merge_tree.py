@@ -68,6 +68,62 @@ class SlidePolicy(enum.IntEnum):
     TRANSIENT = 2
 
 
+class TrackingGroup:
+    """A membership set over segments that survives splits and zamboni.
+
+    Reference: merge-tree ``TrackingGroup`` / ``TrackingGroupCollection`` —
+    the mechanism undo-redo revertibles use to keep hold of the exact
+    segments an op touched: a split adds the right half to every group the
+    left half is in, and zamboni neither frees nor coalesces a tracked
+    segment (a tracked tombstone must stay restorable). Local-session state:
+    never serialized into summaries.
+    """
+
+    def __init__(self):
+        self.segments: List["Segment"] = []
+        # per-segment metadata owned by the group's owner (e.g. undo-redo
+        # keeps an annotate's previous property values here); follows
+        # splits and replace() so it survives segment identity changes
+        self.meta: dict = {}
+
+    def link(self, seg: "Segment") -> None:
+        if self not in seg.tracking:
+            seg.tracking.append(self)
+            self.segments.append(seg)
+
+    def _link_after(self, anchor: "Segment", seg: "Segment") -> None:
+        seg.tracking.append(self)
+        self.segments.insert(self.segments.index(anchor) + 1, seg)
+        if id(anchor) in self.meta:  # a split half carries the same meta
+            self.meta[id(seg)] = self.meta[id(anchor)]
+
+    def unlink(self, seg: "Segment") -> None:
+        if self in seg.tracking:
+            seg.tracking.remove(self)
+            self.segments.remove(seg)
+            self.meta.pop(id(seg), None)
+
+    def replace(self, old: "Segment", new: "Segment") -> None:
+        """Swap membership (and meta) from ``old`` to ``new`` in place —
+        used when a revert re-inserts a tombstone's content as a fresh
+        segment that should inherit the tombstone's tracked role."""
+        idx = self.segments.index(old)
+        old.tracking.remove(self)
+        self.segments[idx] = new
+        new.tracking.append(self)
+        if id(old) in self.meta:
+            self.meta[id(new)] = self.meta.pop(id(old))
+
+    def clear(self) -> None:
+        for seg in self.segments:
+            seg.tracking.remove(self)
+        self.segments = []
+        self.meta = {}
+
+    def __len__(self) -> int:
+        return len(self.segments)
+
+
 @dataclasses.dataclass(eq=False)  # identity equality: two refs at the same
 class LocalReference:             # spot are still distinct anchors
     """A position anchored to (segment, offset) that survives remote edits.
@@ -97,6 +153,8 @@ class Segment:
     pending_annotates: List[Tuple[int, dict]] = dataclasses.field(default_factory=list)
     # payload identity for the device/text side table: (op handle, split offset)
     handle: Tuple[int, int] = (0, 0)
+    # tracking groups holding this segment (see TrackingGroup)
+    tracking: List["TrackingGroup"] = dataclasses.field(default_factory=list)
 
     @property
     def length(self) -> int:
@@ -212,6 +270,8 @@ class MergeTree:
             r.segment = right
             r.offset -= offset
         right.refs = moved
+        for group in seg.tracking:
+            group._link_after(seg, right)
         self.segments.insert(idx + 1, right)
 
     def _find_insertion_index(
@@ -347,11 +407,16 @@ class MergeTree:
         client: int,
         ref_seq: int,
         local_op: Optional[int] = None,
-    ) -> List[Segment]:
+    ) -> List[Tuple[Segment, dict]]:
         """Apply an annotate op: per-key last-sequenced-writer-wins.
-        A ``None`` value deletes the key (reference: annotate semantics)."""
+        A ``None`` value deletes the key (reference: annotate semantics).
+        Returns (segment, previous values of the touched keys) pairs — the
+        previous values are what an undo-redo revertible restores (a key
+        absent before maps to None, so its revert deletes it)."""
         segs = self._resolve_range(start, end, ref_seq, client)
+        out = []
         for seg in segs:
+            prev = {k: seg.props.get(k) for k in props}
             for k, v in props.items():
                 if v is None:
                     seg.props.pop(k, None)
@@ -359,7 +424,8 @@ class MergeTree:
                     seg.props[k] = v
             if local_op is not None:
                 seg.pending_annotates.append((local_op, dict(props)))
-        return segs
+            out.append((seg, prev))
+        return out
 
     # ------------------------------------------------------------------- acks
 
@@ -492,6 +558,8 @@ class MergeTree:
                 and seg.removed_seq != SEQ_UNASSIGNED
                 and seg.removed_seq <= self.min_seq
                 and seg.local_remove_op is None
+                # a tracked tombstone stays restorable (undo-redo holds it)
+                and not seg.tracking
             )
 
         for idx, seg in enumerate(self.segments):
@@ -517,6 +585,8 @@ class MergeTree:
                 and seg.seq <= self.min_seq
                 and not prev.pending_annotates
                 and not seg.pending_annotates
+                and not prev.tracking
+                and not seg.tracking
                 and prev.props == seg.props
                 # only halves of the SAME insert op re-coalesce: handle[0] is
                 # unique per insert (0 = unknown provenance, never merged)

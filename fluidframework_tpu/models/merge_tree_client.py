@@ -21,6 +21,10 @@ from .merge_tree import MergeTree, SegmentKind, LOCAL_VIEW
 
 
 class SequenceClient:
+    # set by every tree mutation (local apply and remote apply): the
+    # affected segments, for the owning DDS's "sequenceDelta" event
+    last_delta: Optional[Dict[str, Any]] = None
+
     def __init__(self, client_id: int):
         self.client_id = client_id
         self.tree = MergeTree(client_id)
@@ -56,11 +60,12 @@ class SequenceClient:
                           props: Optional[dict] = None) -> Dict[str, Any]:
         self._check_pos(pos)
         self.client_seq += 1
-        self.tree.insert(
+        seg = self.tree.insert(
             pos, SegmentKind.TEXT, text, SEQ_UNASSIGNED, self.client_id,
             LOCAL_VIEW, props=props, local_op=self.client_seq,
             handle=self._op_handle(self.client_id, self.client_seq),
         )
+        self.last_delta = {"operation": "insert", "segments": [seg]}
         op_id = self._record_pending("insert")
         return {"mt": "insert", "pos": pos, "kind": int(SegmentKind.TEXT),
                 "text": text, "props": props, "clientSeq": op_id}
@@ -69,11 +74,12 @@ class SequenceClient:
                             props: Optional[dict] = None) -> Dict[str, Any]:
         self._check_pos(pos)
         self.client_seq += 1
-        self.tree.insert(
+        seg = self.tree.insert(
             pos, SegmentKind.MARKER, "", SEQ_UNASSIGNED, self.client_id,
             LOCAL_VIEW, props=props, local_op=self.client_seq,
             handle=self._op_handle(self.client_id, self.client_seq),
         )
+        self.last_delta = {"operation": "insert", "segments": [seg]}
         op_id = self._record_pending("insert")
         return {"mt": "insert", "pos": pos, "kind": int(SegmentKind.MARKER),
                 "text": "", "props": props, "clientSeq": op_id}
@@ -81,10 +87,11 @@ class SequenceClient:
     def remove_range_local(self, start: int, end: int) -> Dict[str, Any]:
         self._check_range(start, end)
         self.client_seq += 1
-        self.tree.mark_range_removed(
+        marked = self.tree.mark_range_removed(
             start, end, SEQ_UNASSIGNED, self.client_id, LOCAL_VIEW,
             local_op=self.client_seq,
         )
+        self.last_delta = {"operation": "remove", "segments": marked}
         op_id = self._record_pending("remove")
         return {"mt": "remove", "start": start, "end": end, "clientSeq": op_id}
 
@@ -92,10 +99,13 @@ class SequenceClient:
                              props: dict) -> Dict[str, Any]:
         self._check_range(start, end)
         self.client_seq += 1
-        self.tree.annotate_range(
+        pairs = self.tree.annotate_range(
             start, end, props, SEQ_UNASSIGNED, self.client_id, LOCAL_VIEW,
             local_op=self.client_seq,
         )
+        self.last_delta = {"operation": "annotate",
+                           "segments": [s for s, _ in pairs],
+                           "previous_properties": pairs}
         op_id = self._record_pending("annotate")
         return {"mt": "annotate", "start": start, "end": end, "props": props,
                 "clientSeq": op_id}
@@ -131,20 +141,25 @@ class SequenceClient:
     def _apply_remote(self, msg: SequencedDocumentMessage) -> None:
         op = msg.contents
         if op["mt"] == "insert":
-            self.tree.insert(
+            seg = self.tree.insert(
                 op["pos"], SegmentKind(op["kind"]), op["text"],
                 msg.seq, msg.client_id, msg.ref_seq, props=op.get("props"),
                 handle=self._op_handle(msg.client_id, op["clientSeq"]),
             )
+            self.last_delta = {"operation": "insert", "segments": [seg]}
         elif op["mt"] == "remove":
-            self.tree.mark_range_removed(
+            marked = self.tree.mark_range_removed(
                 op["start"], op["end"], msg.seq, msg.client_id, msg.ref_seq,
             )
+            self.last_delta = {"operation": "remove", "segments": marked}
         elif op["mt"] == "annotate":
-            self.tree.annotate_range(
+            pairs = self.tree.annotate_range(
                 op["start"], op["end"], op["props"], msg.seq, msg.client_id,
                 msg.ref_seq,
             )
+            self.last_delta = {"operation": "annotate",
+                               "segments": [s for s, _ in pairs],
+                               "previous_properties": pairs}
         else:
             raise ValueError(f"unknown merge-tree op {op['mt']!r}")
 

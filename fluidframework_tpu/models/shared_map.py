@@ -20,6 +20,17 @@ from ..core.protocol import SequencedDocumentMessage
 from .shared_object import SharedObject
 
 
+class _NoValue:
+    """Sentinel for "the key was absent" in valueChanged events — distinct
+    from a stored ``None`` (a legal value here, unlike JS ``undefined``)."""
+
+    def __repr__(self):
+        return "NO_VALUE"
+
+
+NO_VALUE = _NoValue()
+
+
 class MapKernel:
     """Op-application core shared by SharedMap and each directory node.
 
@@ -70,7 +81,11 @@ class MapKernel:
             self.acked.pop(op["key"], None)
 
     # sequenced inbox
-    def process(self, op: dict, local: bool) -> None:
+    def process(self, op: dict, local: bool) -> list:
+        """Apply a sequenced op. Returns the VISIBLE changes it caused, for
+        the owner to emit as events: ``("valueChanged", key, previous)`` /
+        ``("clear", previous_contents)``. Local echoes and remote ops
+        shadowed by in-flight local state cause none."""
         self._apply_acked(op)
         kind = op["op"]
         if local:
@@ -85,23 +100,29 @@ class MapKernel:
                     self.pending_keys.pop(entry, None)
                 else:
                     self.pending_keys[entry] = n
-            return
+            return []
         if kind == "clear":
             if self.pending_clears > 0:
-                return  # our pending clear supersedes everything before it
+                return []  # our pending clear supersedes everything before it
             # remote clear wipes acked state but keys with in-flight local
             # ops survive (those ops are sequenced after the clear)
             survivors = {k: self.data[k] for k in self.pending_keys
                          if k in self.data}
+            wiped = {k: v for k, v in self.data.items()
+                     if k not in survivors}
             self.data = survivors
-            return
+            return [("clear", wiped)] if wiped else []
         key = op["key"]
         if self.pending_clears > 0 or key in self.pending_keys:
-            return  # shadowed by in-flight local ops for this key / clear
+            return []  # shadowed by in-flight local ops for this key / clear
+        previous = self.data.get(key, NO_VALUE)
         if kind == "set":
             self.data[key] = op["value"]
         elif kind == "delete":
+            if previous is NO_VALUE:
+                return []  # deleting an absent key changes nothing
             self.data.pop(key, None)
+        return [("valueChanged", key, previous)]
 
 
 class SharedMap(SharedObject):
@@ -111,9 +132,14 @@ class SharedMap(SharedObject):
         super().__init__(object_id, client_id)
         self.kernel = MapKernel()
 
-    # public API (reference: SharedMap.set/get/delete/has/clear)
+    # public API (reference: SharedMap.set/get/delete/has/clear).
+    # Local edits emit their event at submit (the optimistic apply is the
+    # visible change), remote ops at process — matching the reference's
+    # "valueChanged"/"clear" emitter contract.
     def set(self, key: str, value: Any) -> None:
+        previous = self.kernel.data.get(key, NO_VALUE)
         self.submit_local_message(self.kernel.set_local(key, value))
+        self._emit("valueChanged", self, key, previous, True)
 
     def get(self, key: str, default: Any = None) -> Any:
         return self.kernel.data.get(key, default)
@@ -122,10 +148,16 @@ class SharedMap(SharedObject):
         return key in self.kernel.data
 
     def delete(self, key: str) -> None:
+        previous = self.kernel.data.get(key, NO_VALUE)
         self.submit_local_message(self.kernel.delete_local(key))
+        if previous is not NO_VALUE:
+            self._emit("valueChanged", self, key, previous, True)
 
     def clear(self) -> None:
+        previous = dict(self.kernel.data)
         self.submit_local_message(self.kernel.clear_local())
+        if previous:
+            self._emit("clear", self, previous, True)
 
     def keys(self) -> Iterator[str]:
         return iter(sorted(self.kernel.data))
@@ -137,7 +169,11 @@ class SharedMap(SharedObject):
         return sorted(self.kernel.data.items())
 
     def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
-        self.kernel.process(msg.contents, local)
+        for change in self.kernel.process(msg.contents, local):
+            if change[0] == "valueChanged":
+                self._emit("valueChanged", self, change[1], change[2], False)
+            else:
+                self._emit("clear", self, change[1], False)
 
     def apply_stashed_op(self, contents: dict) -> None:
         kind = contents["op"]

@@ -33,6 +33,26 @@ class SharedObject:
         self.last_processed_seq = 0
         self._submit_fn: Optional[Callable[[dict], None]] = None
         self._attached = False
+        self._listeners: Dict[str, list] = {}
+
+    # ---------------------------------------------------------------- events
+    # Reference: DDSes are EventEmitters (SharedMap "valueChanged"/"clear",
+    # sequences "sequenceDelta"); undo-redo and app views subscribe here.
+
+    def on(self, event: str, listener: Callable) -> Callable:
+        """Subscribe; returns the listener for later ``off``."""
+        self._listeners.setdefault(event, []).append(listener)
+        return listener
+
+    def off(self, event: str, listener: Callable) -> None:
+        try:
+            self._listeners.get(event, []).remove(listener)
+        except ValueError:
+            pass
+
+    def _emit(self, event: str, *args) -> None:
+        for listener in list(self._listeners.get(event, [])):
+            listener(*args)
 
     # ------------------------------------------------------------- lifecycle
 

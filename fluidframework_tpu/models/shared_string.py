@@ -41,6 +41,8 @@ class SharedString(SharedObject):
         self._iv_last_ticket: Dict[tuple, int] = {}
         # {old clientSeq: [regenerated ops]} during a reconnect resubmit
         self._regen_cache: Optional[Dict[int, list]] = None
+        # most recent sequenceDelta (see _emit_delta)
+        self.last_delta: Optional[dict] = None
 
     @property
     def tree(self) -> MergeTree:
@@ -50,15 +52,30 @@ class SharedString(SharedObject):
 
     def insert_text(self, pos: int, text: str, props: Optional[dict] = None):
         self.submit_local_message(self.client.insert_text_local(pos, text, props))
+        self._emit_delta(True)
 
     def insert_marker(self, pos: int, props: Optional[dict] = None):
         self.submit_local_message(self.client.insert_marker_local(pos, props))
+        self._emit_delta(True)
 
     def remove_text(self, start: int, end: int):
         self.submit_local_message(self.client.remove_range_local(start, end))
+        self._emit_delta(True)
 
     def annotate_range(self, start: int, end: int, props: dict):
         self.submit_local_message(self.client.annotate_range_local(start, end, props))
+        self._emit_delta(True)
+
+    def _emit_delta(self, local: bool) -> None:
+        """Fire "sequenceDelta" with the segments the last op touched
+        (reference: SharedSegmentSequence sequenceDelta events, which carry
+        the merge-tree delta — what undo-redo and views subscribe to).
+        The delta stays readable as ``last_delta`` (undo-redo reverts need
+        the segment a revert-insert just created, to transfer tracking)."""
+        delta, self.client.last_delta = self.client.last_delta, None
+        if delta is not None:
+            self.last_delta = delta
+            self._emit("sequenceDelta", self, delta, local)
 
     def get_text(self) -> str:
         return self.client.get_text()
@@ -93,6 +110,7 @@ class SharedString(SharedObject):
                 self.client._ack(msg)
             else:
                 self.client._apply_remote(msg)
+                self._emit_delta(False)
             self.client.last_processed_seq = msg.seq
             return
         if "iv" in op:

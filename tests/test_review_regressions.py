@@ -141,3 +141,43 @@ def test_interval_docs_stay_batched_until_tombstone_crossing():
                                "text": "z"})) for s in (21, 22)]
     store.apply_messages(stream2)
     assert len(batches) == 2  # split once, at the min_seq=19>=18 crossing
+
+
+def test_map_remote_delete_of_absent_key_emits_nothing():
+    """Concurrent deletes of the same key: the second remote delete is a
+    no-op and must NOT emit a phantom valueChanged (confirmed review
+    repro: a third replica saw two events for one logical deletion)."""
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedMap, "m")
+    b = create_connected_dds(seqr, SharedMap, "m")
+    c = create_connected_dds(seqr, SharedMap, "m")
+    a.set("k", 1)
+    seqr.process_all_messages()
+    events = []
+    c.on("valueChanged", lambda m, k, prev, local: events.append((k, prev)))
+    a.delete("k")
+    b.delete("k")  # concurrent: sequenced after a's delete
+    seqr.process_all_messages()
+    assert events == [("k", 1)]
+
+
+def test_map_undo_restores_stored_none():
+    """None is a legal stored value (unlike JS undefined): undo of a set
+    over a None-valued key must restore None, not delete the key."""
+    from fluidframework_tpu.framework.undo_redo import (
+        SharedMapUndoRedoHandler, UndoRedoStackManager)
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedMap, "m")
+    stack = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stack).attach(a)
+    a.set("k", None)
+    stack.close_current_operation()
+    a.set("k", 5)
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    assert a.has("k") and a.get("k") is None
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    assert not a.has("k")

@@ -1,0 +1,341 @@
+"""Undo-redo package (reference: @fluidframework/undo-redo) + DDS events.
+
+The reference tests this with mock-runtime multi-client setups: local edits
+push revertibles via DDS events; undo issues ordinary ops so replicas
+converge. Key reference behaviors pinned here: operation grouping, redo
+cleared by fresh edits, revert-of-remove restoring text+props at the slid
+position, tracked tombstones surviving zamboni, and annotate reverts
+restoring previous values across segment splits.
+"""
+
+import random
+
+from fluidframework_tpu.core.protocol import MessageType
+from fluidframework_tpu.framework.undo_redo import (
+    SharedMapUndoRedoHandler,
+    SharedSegmentSequenceUndoRedoHandler,
+    UndoRedoStackManager,
+)
+from fluidframework_tpu.models import SharedMap, SharedString
+from fluidframework_tpu.testing.mocks import MockSequencer, create_connected_dds
+
+
+def _pair(seqr, cls):
+    return (create_connected_dds(seqr, cls, "x"),
+            create_connected_dds(seqr, cls, "x"))
+
+
+def _mk_undo(dds, handler_cls):
+    stack = UndoRedoStackManager()
+    handler = handler_cls(stack)
+    handler.attach(dds)
+    return stack
+
+
+# ------------------------------------------------------------------ events
+
+
+def test_map_value_changed_events():
+    from fluidframework_tpu.models.shared_map import NO_VALUE
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedMap)
+    got = []
+    b.on("valueChanged", lambda m, k, prev, local: got.append((k, prev, local)))
+    a.set("k", 1)
+    seqr.process_all_messages()
+    assert got == [("k", NO_VALUE, False)]
+    a.set("k", 2)
+    seqr.process_all_messages()
+    assert got[-1] == ("k", 1, False)
+    # local emission on the editing replica
+    local_got = []
+    a.on("valueChanged", lambda m, k, prev, local: local_got.append((k, prev, local)))
+    a.set("k", 3)
+    assert local_got == [("k", 2, True)]
+    seqr.process_all_messages()
+    # concurrent remote op shadowed by a's in-flight local op: no event on a
+    b.set("k", 99)   # sequenced FIRST
+    a.set("k", 100)  # a's local op in flight when b's arrives
+    n_before = len(local_got)
+    seqr.process_all_messages()
+    remote_events = [e for e in local_got[n_before:] if not e[2]]
+    assert remote_events == []  # b's set was shadowed on a
+    assert a.get("k") == b.get("k") == 100
+
+
+def test_string_sequence_delta_events():
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    got = []
+    b.on("sequenceDelta", lambda s, d, local: got.append((d["operation"], local)))
+    a.insert_text(0, "hi")
+    seqr.process_all_messages()
+    assert got == [("insert", False)]
+    a.remove_text(0, 1)
+    seqr.process_all_messages()
+    assert got[-1] == ("remove", False)
+
+
+# -------------------------------------------------------------------- map
+
+
+def test_map_undo_redo_roundtrip():
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedMap)
+    stack = _mk_undo(a, SharedMapUndoRedoHandler)
+    a.set("k", "v1")
+    stack.close_current_operation()
+    a.set("k", "v2")
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    assert a.get("k") == b.get("k") == "v1"
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    assert not a.has("k") and not b.has("k")
+    assert stack.redo_operation()
+    seqr.process_all_messages()
+    assert a.get("k") == b.get("k") == "v1"
+    assert stack.redo_operation()
+    seqr.process_all_messages()
+    assert a.get("k") == b.get("k") == "v2"
+    assert not stack.redo_operation()
+
+
+def test_map_undo_grouped_operation_and_clear():
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedMap)
+    stack = _mk_undo(a, SharedMapUndoRedoHandler)
+    a.set("x", 1)
+    a.set("y", 2)
+    stack.close_current_operation()  # one gesture = one operation
+    a.clear()
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    assert len(a) == 0
+    assert stack.undo_operation()  # undo the clear restores both keys
+    seqr.process_all_messages()
+    assert b.items() == [("x", 1), ("y", 2)]
+    assert stack.undo_operation()  # undo the grouped sets removes both
+    seqr.process_all_messages()
+    assert len(a) == len(b) == 0
+
+
+def test_map_fresh_edit_clears_redo():
+    seqr = MockSequencer()
+    a, _ = _pair(seqr, SharedMap)
+    stack = _mk_undo(a, SharedMapUndoRedoHandler)
+    a.set("k", 1)
+    stack.close_current_operation()
+    stack.undo_operation()
+    assert stack.redo_stack_size == 1
+    a.set("k", 5)  # fresh edit in normal mode
+    assert stack.redo_stack_size == 0
+    assert not stack.redo_operation()
+
+
+# ------------------------------------------------------------------ string
+
+
+def test_string_undo_insert_remove_annotate():
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    stack = _mk_undo(a, SharedSegmentSequenceUndoRedoHandler)
+
+    a.insert_text(0, "hello world")
+    stack.close_current_operation()
+    a.annotate_range(0, 5, {"bold": True})
+    stack.close_current_operation()
+    a.remove_text(5, 11)
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    assert b.get_text() == "hello"
+
+    assert stack.undo_operation()  # undo remove: " world" restored
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "hello world"
+
+    assert stack.undo_operation()  # undo annotate: bold gone
+    seqr.process_all_messages()
+    assert a.get_properties(0) == {} and b.get_properties(0) == {}
+
+    assert stack.undo_operation()  # undo insert: empty doc
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == ""
+
+    assert stack.redo_operation()  # redo insert
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "hello world"
+    assert stack.redo_operation()  # redo annotate
+    seqr.process_all_messages()
+    assert b.get_properties(0) == {"bold": True}
+    assert stack.redo_operation()  # redo remove
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "hello"
+
+
+def test_string_undo_remove_restores_props_and_markers():
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    stack = _mk_undo(a, SharedSegmentSequenceUndoRedoHandler)
+    a.insert_text(0, "ab", {"k": 1})
+    a.insert_marker(2, {"m": True})
+    a.insert_text(3, "cd", {"k": 2})
+    seqr.process_all_messages()
+    stack.close_current_operation()  # don't undo the setup
+
+    a.remove_text(1, 4)  # "b", marker, "c"
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    assert a.get_text() == "ad"
+
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "abcd"
+    assert b.get_properties(1) == {"k": 1}
+    assert b.get_properties(3) == {"k": 2}
+    # the marker is back between b and c
+    seg, _ = b.tree.get_containing_segment(2)
+    assert seg.props == {"m": True}
+
+
+def test_string_undo_positions_shift_with_remote_edits():
+    """Undo after remote edits moved the content: revert targets the
+    tracked segments' CURRENT positions."""
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    stack = _mk_undo(a, SharedSegmentSequenceUndoRedoHandler)
+    a.insert_text(0, "world")
+    seqr.process_all_messages()
+    stack.close_current_operation()
+
+    a.insert_text(5, "!")  # the op we will undo
+    stack.close_current_operation()
+    b.insert_text(0, "hello ")  # concurrent remote edit shifts positions
+    seqr.process_all_messages()
+    assert a.get_text() == "hello world!"
+
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "hello world"
+
+
+def test_string_undo_insert_split_by_remote_insert():
+    """A remote insert lands INSIDE my tracked insert: undo removes both
+    halves of mine but keeps the remote text."""
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    stack = _mk_undo(a, SharedSegmentSequenceUndoRedoHandler)
+    a.insert_text(0, "aaaa")
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    b.insert_text(2, "BB")
+    seqr.process_all_messages()
+    assert a.get_text() == "aaBBaa"
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "BB"
+
+
+def test_string_undo_remove_survives_zamboni():
+    """The tracked tombstone must survive the collaboration window closing
+    (zamboni spares tracked segments), so undo still restores the text."""
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    stack = _mk_undo(a, SharedSegmentSequenceUndoRedoHandler)
+    a.insert_text(0, "keep DROP keep")
+    seqr.process_all_messages()
+    stack.close_current_operation()
+    a.remove_text(5, 10)
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    # advance MSN well past the remove on every replica → zamboni runs
+    for _ in range(3):
+        for r in (a, b):
+            seqr.submit(r, {}, type=MessageType.NOOP)
+        seqr.process_all_messages()
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text() == "keep DROP keep"
+
+
+def test_string_annotate_undo_across_split():
+    """Annotate, then a remote insert splits the annotated segment; undo
+    must restore previous values on BOTH split halves."""
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    stack = _mk_undo(a, SharedSegmentSequenceUndoRedoHandler)
+    a.insert_text(0, "abcdef", {"color": "red"})
+    seqr.process_all_messages()
+    stack.close_current_operation()
+    a.annotate_range(0, 6, {"color": "blue"})
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    b.insert_text(3, "XY")  # splits the annotated segment
+    seqr.process_all_messages()
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    for replica in (a, b):
+        assert replica.get_properties(0)["color"] == "red"
+        assert replica.get_properties(7)["color"] == "red"
+
+
+def test_undo_discard_unblocks_zamboni():
+    """Clearing the redo stack discards revertibles, unlinking tracking
+    groups so tombstones become collectable again."""
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    stack = _mk_undo(a, SharedSegmentSequenceUndoRedoHandler)
+    a.insert_text(0, "abcdef")
+    seqr.process_all_messages()
+    stack.close_current_operation()
+    a.remove_text(0, 3)
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    stack.undo_operation()  # remove's revertible consumed; redo holds insert-revert
+    seqr.process_all_messages()
+    a.insert_text(0, "Z")  # fresh edit clears redo → discards its tracking
+    seqr.process_all_messages()
+    # the undo stack still tracks LIVE segments (that's its job), but no
+    # tombstone may stay tracked — zamboni must be able to free them
+    assert all(not s.tracking for s in a.tree.segments
+               if s.removed_seq is not None)
+    for _ in range(3):  # MSN catch-up: zamboni reclaims the tombstones
+        for r in (a, b):
+            seqr.submit(r, {}, type=MessageType.NOOP)
+        seqr.process_all_messages()
+    assert all(s.removed_seq is None for s in a.tree.segments)
+
+
+def test_undo_fuzz_converges():
+    """Random edits + undos on one replica, concurrent edits on the other:
+    all replicas converge after every drain (undo ops are ordinary ops)."""
+    rng = random.Random(11)
+    seqr = MockSequencer()
+    a, b = _pair(seqr, SharedString)
+    stack = _mk_undo(a, SharedSegmentSequenceUndoRedoHandler)
+    for round_no in range(60):
+        r = rng.random()
+        n_a, n_b = a.get_length(), b.get_length()
+        if r < 0.35 or n_a == 0:
+            a.insert_text(rng.randint(0, n_a), rng.choice("xyzw") * rng.randint(1, 3))
+            stack.close_current_operation()
+        elif r < 0.55:
+            s = rng.randrange(n_a)
+            a.remove_text(s, rng.randint(s + 1, min(n_a, s + 4)))
+            stack.close_current_operation()
+        elif r < 0.7 and n_b > 0:
+            s = rng.randrange(n_b)
+            b.insert_text(s, "R")
+        elif r < 0.85:
+            stack.undo_operation()
+        else:
+            stack.redo_operation()
+        if rng.random() < 0.4:
+            seqr.process_some(rng.randint(0, seqr.outstanding))
+        else:
+            seqr.process_all_messages()
+    seqr.process_all_messages()
+    assert a.get_text() == b.get_text()
+    assert a.tree.structure_digest() == b.tree.structure_digest()
