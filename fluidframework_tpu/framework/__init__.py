@@ -11,6 +11,7 @@ from .presence import PresenceManager
 from .undo_redo import (
     SharedMapUndoRedoHandler,
     SharedSegmentSequenceUndoRedoHandler,
+    SharedTreeUndoRedoHandler,
     UndoRedoStackManager,
 )
 
@@ -24,5 +25,6 @@ __all__ = [
     "PresenceManager",
     "SharedMapUndoRedoHandler",
     "SharedSegmentSequenceUndoRedoHandler",
+    "SharedTreeUndoRedoHandler",
     "UndoRedoStackManager",
 ]

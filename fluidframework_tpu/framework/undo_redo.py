@@ -236,6 +236,50 @@ class SharedSegmentSequenceRevertible:
         self.group.clear()
 
 
+class SharedTreeRevertible:
+    """Revert one tree delta by submitting its inverse edits (computed
+    against the pre-state at edit time). Inverses are ordinary edits and
+    degrade under the tree's merge rules if concurrent edits intervened
+    (reference: SharedTree revertibles on the commit graph)."""
+
+    def __init__(self, tree, inverse: List[dict]):
+        self.tree, self.inverse = tree, inverse
+
+    def revert(self) -> None:
+        for op in self.inverse:
+            if op["op"] == "transaction":
+                self.tree.run_transaction(
+                    lambda t, edits=op["edits"]: [
+                        t._submit_edit(e) for e in edits])
+            else:
+                self.tree._submit_edit(op)
+
+    def discard(self) -> None:
+        pass
+
+
+class SharedTreeUndoRedoHandler:
+    """Reference: SharedTree undo/redo support via revertible commits."""
+
+    def __init__(self, stack: UndoRedoStackManager):
+        self.stack = stack
+        self._subs: List[Tuple[Any, str, Any]] = []
+
+    def attach(self, tree) -> None:
+        self._subs.append(
+            (tree, "treeDelta", tree.on("treeDelta", self._tree_delta)))
+
+    def detach(self) -> None:
+        for obj, event, listener in self._subs:
+            obj.off(event, listener)
+        self._subs.clear()
+
+    def _tree_delta(self, tree, delta, local) -> None:
+        if local and delta.get("inverse"):
+            self.stack.push_to_current_operation(
+                SharedTreeRevertible(tree, delta["inverse"]))
+
+
 class SharedSegmentSequenceUndoRedoHandler:
     """Reference: ``SharedSegmentSequenceUndoRedoHandler.attachSequence``."""
 

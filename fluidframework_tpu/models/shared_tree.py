@@ -43,11 +43,22 @@ ROOT = "root"
 
 
 class TreeSchema:
-    """Allowed node types and their fields (reference: view schema)."""
+    """Allowed node types, their fields, and per-field allowed child types
+    (reference: view schema / SchemaFactory allowedTypes).
 
-    def __init__(self, types: Dict[str, List[str]]):
-        # type name -> allowed sequence-field names
-        self.types = {t: list(fs) for t, fs in types.items()}
+    ``types`` maps a type name to either a list of field names (any child
+    type allowed — the original shorthand) or a dict
+    ``{field: [allowed child types] | None}`` (None = any type).
+    """
+
+    def __init__(self, types: Dict[str, Any]):
+        self.types: Dict[str, Dict[str, Optional[List[str]]]] = {}
+        for t, fields in types.items():
+            if isinstance(fields, dict):
+                self.types[t] = {f: (list(a) if a is not None else None)
+                                 for f, a in fields.items()}
+            else:
+                self.types[t] = {f: None for f in fields}
 
     def check_node(self, node_type: Optional[str]) -> None:
         if node_type is not None and node_type not in self.types:
@@ -57,6 +68,19 @@ class TreeSchema:
         if node_type is not None and field not in self.types.get(node_type, ()):
             raise ValueError(
                 f"type {node_type!r} has no field {field!r}")
+
+    def check_child(self, parent_type: Optional[str], field: str,
+                    child_type: Optional[str]) -> None:
+        """Validate that ``child_type`` may live under ``parent_type.field``
+        (only enforced when the parent is typed and the field constrains
+        its allowed types)."""
+        if parent_type is None:
+            return
+        allowed = self.types.get(parent_type, {}).get(field)
+        if allowed is not None and child_type not in allowed:
+            raise ValueError(
+                f"type {child_type!r} not allowed under "
+                f"{parent_type!r}.{field!r} (allowed: {allowed})")
 
 
 class _Tree:
@@ -81,7 +105,23 @@ class _Tree:
             return self._move(op)
         if kind == "setValue":
             return self._set_value(op)
+        if kind == "transaction":
+            return self._transaction(op)
         raise ValueError(f"unknown tree op {kind!r}")
+
+    def _transaction(self, op: dict) -> bool:
+        """Atomic edit group (reference: Tree.runTransaction). Constraints
+        gate the WHOLE group against the merged state — if any fails
+        (e.g. a node a concurrent op removed must still exist), every edit
+        in the group is dropped. Individual edits inside an admitted group
+        still degrade one by one under the normal merge rules."""
+        for c in op.get("constraints", ()):
+            if "nodeExists" in c and c["nodeExists"] not in self.nodes:
+                return False
+        applied = False
+        for sub in op["edits"]:
+            applied = self.apply(sub) or applied
+        return applied
 
     def _attach_at_anchor(self, node_id: str, parent_id: str, field: str,
                           after: Optional[str]) -> None:
@@ -101,14 +141,34 @@ class _Tree:
             return False                 # duplicate delivery guard
         after = op.get("after")
         for spec in op["nodes"]:
-            self.nodes[spec["id"]] = {
-                "id": spec["id"], "type": spec.get("type"),
-                "value": spec.get("value"), "parent": None, "field": None,
-                "children": {}}
+            self._materialize(spec)
             self._attach_at_anchor(spec["id"], op["parent"], op["field"],
                                    after)
             after = spec["id"]           # chain multi-node inserts in order
         return True
+
+    def _materialize(self, spec: dict) -> None:
+        """Create a node (and, recursively, its nested children) from an
+        insert spec — nested specs carry whole subtrees, which is how an
+        undo of a subtree remove restores it in one edit.
+
+        A nested spec whose id ALREADY exists is skipped, subtree and all:
+        that node survived elsewhere (e.g. concurrently moved out before
+        the remove this insert is undoing), and re-creating it would leave
+        one id attached in two places — corrupting every replica."""
+        nid = spec["id"]
+        self.nodes[nid] = {
+            "id": nid, "type": spec.get("type"),
+            "value": spec.get("value"), "parent": None, "field": None,
+            "children": {}}
+        for field, child_specs in (spec.get("children") or {}).items():
+            for child in child_specs:
+                if child["id"] in self.nodes:
+                    continue
+                self._materialize(child)
+                self._attach_at_anchor(
+                    child["id"], nid, field,
+                    self.nodes[nid]["children"].get(field, [None])[-1])
 
     def _detach(self, node_id: str) -> None:
         node = self.nodes[node_id]
@@ -145,6 +205,69 @@ class _Tree:
         self.nodes[op["id"]]["value"] = op["value"]
         return True
 
+    # ------------------------------------------------------------- inverses
+
+    def subtree_spec(self, node_id: str) -> dict:
+        """Recursive insert spec for the node's whole subtree (what an
+        inverse of remove re-inserts)."""
+        node = self.nodes[node_id]
+        spec = {"id": node_id, "type": node["type"], "value": node["value"]}
+        children = {f: [self.subtree_spec(c) for c in cs]
+                    for f, cs in node["children"].items() if cs}
+        if children:
+            spec["children"] = children
+        return spec
+
+    def _prev_sibling(self, node_id: str) -> Optional[str]:
+        node = self.nodes[node_id]
+        sibs = self.nodes[node["parent"]]["children"][node["field"]]
+        idx = sibs.index(node_id)
+        return sibs[idx - 1] if idx > 0 else None
+
+    def inverse_of(self, op: dict) -> List[dict]:
+        """Inverse edits for ``op`` against THIS state (must be the state
+        the op is about to apply to). Inverses are ordinary edits — undo
+        submits them through the normal op path, so they degrade under the
+        same merge rules if concurrent edits intervened."""
+        kind = op["op"]
+        if kind == "insert":
+            return [{"op": "remove", "id": spec["id"]}
+                    for spec in reversed(op["nodes"])]
+        if kind == "remove":
+            nid = op["id"]
+            if nid not in self.nodes:
+                return []
+            node = self.nodes[nid]
+            return [{"op": "insert", "parent": node["parent"],
+                     "field": node["field"],
+                     "after": self._prev_sibling(nid),
+                     "nodes": [self.subtree_spec(nid)]}]
+        if kind == "move":
+            nid = op["id"]
+            if nid not in self.nodes:
+                return []
+            node = self.nodes[nid]
+            return [{"op": "move", "id": nid, "parent": node["parent"],
+                     "field": node["field"],
+                     "after": self._prev_sibling(nid)}]
+        if kind == "setValue":
+            if op["id"] not in self.nodes:
+                return []
+            return [{"op": "setValue", "id": op["id"],
+                     "value": self.nodes[op["id"]]["value"]}]
+        if kind == "transaction":
+            # inverse of a group: each edit's inverse against the state it
+            # saw, groups replayed in reverse order, as one atomic group
+            scratch = copy.deepcopy(self)
+            per_edit: List[List[dict]] = []
+            for sub in op["edits"]:
+                per_edit.append(scratch.inverse_of(sub))
+                scratch.apply(sub)
+            inverses = [e for grp in reversed(per_edit) for e in grp]
+            return [{"op": "transaction", "edits": inverses}] \
+                if inverses else []
+        raise ValueError(f"unknown tree op {kind!r}")
+
     # -------------------------------------------------------------- queries
 
     def _subtree_ids(self, node_id: str) -> Iterator[str]:
@@ -177,6 +300,19 @@ class TreeKernel:
         self.view.apply(op)
         self.pending.append(op)
 
+    # a transaction edits a scratch view (its fn reads its own writes);
+    # the composite op re-applies through local_op on commit
+    def begin_txn(self) -> None:
+        self._txn_backup = self.view
+        self.view = copy.deepcopy(self.view)
+
+    def view_for_txn(self) -> _Tree:
+        return self.view
+
+    def abort_txn(self) -> None:
+        self.view = self._txn_backup
+        self._txn_backup = None
+
     def process(self, op: dict, local: bool) -> None:
         self.acked.apply(op)
         if local:
@@ -204,6 +340,7 @@ class SharedTree(SharedObject):
         self.kernel = TreeKernel()
         self.schema: Optional[TreeSchema] = None
         self._node_counter = 0
+        self._txn: Optional[List[dict]] = None
 
     # ----------------------------------------------------------- public API
 
@@ -223,30 +360,75 @@ class SharedTree(SharedObject):
             self.schema.check_node(node_type)
             parent = self.kernel.view.nodes[parent_id]
             self.schema.check_field(parent["type"], field)
+            self.schema.check_child(parent["type"], field, node_type)
         nid = node_id or self._new_id()
         op = {"op": "insert", "parent": parent_id, "field": field,
               "after": after,
               "nodes": [{"id": nid, "type": node_type, "value": value}]}
-        self.kernel.local_op(op)
-        self.submit_local_message(op)
+        self._submit_edit(op)
         return nid
 
     def remove(self, node_id: str) -> None:
-        op = {"op": "remove", "id": node_id}
-        self.kernel.local_op(op)
-        self.submit_local_message(op)
+        self._submit_edit({"op": "remove", "id": node_id})
 
     def move(self, node_id: str, new_parent: str, field: str,
              after: Optional[str] = None) -> None:
-        op = {"op": "move", "id": node_id, "parent": new_parent,
-              "field": field, "after": after}
-        self.kernel.local_op(op)
-        self.submit_local_message(op)
+        if self.schema is not None:
+            parent = self.kernel.view.nodes[new_parent]
+            moved = self.kernel.view.nodes[node_id]
+            self.schema.check_field(parent["type"], field)
+            self.schema.check_child(parent["type"], field, moved["type"])
+        self._submit_edit({"op": "move", "id": node_id, "parent": new_parent,
+                           "field": field, "after": after})
 
     def set_value(self, node_id: str, value: Any) -> None:
-        op = {"op": "setValue", "id": node_id, "value": value}
+        self._submit_edit({"op": "setValue", "id": node_id, "value": value})
+
+    def _submit_edit(self, op: dict) -> None:
+        """Local apply + submit + "treeDelta" event (with the inverse edits
+        computed against the pre-state, for undo-redo)."""
+        if self._txn is not None:
+            self._txn.append(op)  # deferred: the transaction submits it
+            self.kernel.view_for_txn().apply(op)
+            return
+        # inverse computation walks subtrees (and deep-copies per
+        # transaction): only pay for it when someone is listening
+        listening = bool(self._listeners.get("treeDelta"))
+        inverse = self.kernel.view.inverse_of(op) if listening else []
         self.kernel.local_op(op)
         self.submit_local_message(op)
+        if listening:
+            self._emit("treeDelta", self, {"op": op, "inverse": inverse},
+                       True)
+
+    # ---------------------------------------------------------- transactions
+
+    def run_transaction(self, fn, constraints: Optional[List[dict]] = None):
+        """Run ``fn(tree)`` collecting its edits into ONE atomic op
+        (reference: Tree.runTransaction). If ``fn`` raises, nothing is
+        applied or submitted. ``constraints`` (e.g. ``{"nodeExists": id}``)
+        are checked against the merged state on every replica — failure
+        drops the whole group (reference: transaction constraints)."""
+        if self._txn is not None:
+            raise RuntimeError("transactions do not nest")
+        self._txn = []
+        self.kernel.begin_txn()
+        try:
+            result = fn(self)
+        except BaseException:
+            self._txn = None
+            self.kernel.abort_txn()
+            raise
+        edits = self._txn
+        self._txn = None
+        self.kernel.abort_txn()  # drop scratch; the real op applies below
+        if not edits:
+            return result
+        op = {"op": "transaction", "edits": edits}
+        if constraints:
+            op["constraints"] = list(constraints)
+        self._submit_edit(op)
+        return result
 
     # --------------------------------------------------------------- queries
 
@@ -273,6 +455,8 @@ class SharedTree(SharedObject):
 
     def process_core(self, msg: SequencedDocumentMessage, local: bool) -> None:
         self.kernel.process(msg.contents, local)
+        if not local:
+            self._emit("treeDelta", self, {"op": msg.contents}, False)
 
     def rebase_op(self, contents: dict) -> Optional[dict]:
         # id-anchored ops are position-free: resubmit unchanged (see module

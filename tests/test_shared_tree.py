@@ -211,3 +211,254 @@ class TestSummariesAndFuzz:
             seqr.process_some(rng.randint(0, 4))
         seqr.process_all_messages()
         assert len(digests(trees)) == 1, f"diverged at seed {seed}"
+
+
+# ----------------------------------------------------- transactions & undo
+
+class TestTransactions:
+    def test_transaction_applies_atomically(self):
+        seqr, (a, b) = make_trees()
+        def edits(t):
+            p = t.insert("root", "items", value="p")
+            t.insert(p, "kids", value="c1")
+            t.insert(p, "kids", value="c2")
+            return p
+        p = a.run_transaction(edits)
+        # one op on the wire; before drain b sees nothing
+        assert b.children("root", "items") == []
+        seqr.process_all_messages()
+        assert len(b.children(p, "kids")) == 2
+        assert digests([a, b]) and len(digests([a, b])) == 1
+
+    def test_transaction_rollback_on_exception(self):
+        seqr, (a, b) = make_trees()
+        with pytest.raises(RuntimeError, match="boom"):
+            def bad(t):
+                t.insert("root", "items", value="x")
+                raise RuntimeError("boom")
+            a.run_transaction(bad)
+        seqr.process_all_messages()
+        assert a.children("root", "items") == []
+        assert len(a.kernel.view.nodes) == len(b.kernel.view.nodes) == 1
+
+    def test_transaction_reads_its_own_writes(self):
+        seqr, (a, _) = make_trees()
+        def edits(t):
+            p = t.insert("root", "items", value="p")
+            assert t.value_of(p) == "p"          # visible inside the txn
+            t.set_value(p, "p2")
+            assert t.value_of(p) == "p2"
+            return p
+        p = a.run_transaction(edits)
+        seqr.process_all_messages()
+        assert a.value_of(p) == "p2"
+
+    def test_constraint_drops_whole_group_on_every_replica(self):
+        seqr, (a, b) = make_trees()
+        target = a.insert("root", "items", value="t")
+        seqr.process_all_messages()
+        b.remove(target)                      # concurrent with a's txn
+        a.run_transaction(
+            lambda t: t.insert("root", "items", value="depends"),
+            constraints=[{"nodeExists": target}])
+        seqr.process_all_messages()
+        # b's remove sequenced first -> constraint fails everywhere
+        assert a.children("root", "items") == []
+        assert len(digests([a, b])) == 1
+
+    def test_constraint_holds_group_applies(self):
+        seqr, (a, b) = make_trees()
+        target = a.insert("root", "items", value="t")
+        seqr.process_all_messages()
+        a.run_transaction(
+            lambda t: t.set_value(target, "updated"),
+            constraints=[{"nodeExists": target}])
+        seqr.process_all_messages()
+        assert b.value_of(target) == "updated"
+
+
+class TestSchemaChildTypes:
+    def test_child_type_enforced(self):
+        seqr, (a, _) = make_trees()
+        a.set_schema(TreeSchema({
+            "list": {"items": ["item"]},   # items accepts only "item"
+            "item": {},
+        }))
+        lst = a.insert("root", "items", node_type=None)  # untyped root field
+        # root is untyped: anything goes
+        l2 = a.insert("root", "items", node_type="list")
+        a.insert(l2, "items", node_type="item")
+        with pytest.raises(ValueError, match="not allowed"):
+            a.insert(l2, "items", node_type="list")
+        with pytest.raises(ValueError, match="not allowed"):
+            a.insert(l2, "items", node_type=None)
+
+    def test_move_checks_child_types(self):
+        seqr, (a, _) = make_trees()
+        a.set_schema(TreeSchema({
+            "list": {"items": ["item"]}, "item": {}, "other": {}}))
+        lst = a.insert("root", "f", node_type="list")
+        other = a.insert("root", "f", node_type="other")
+        with pytest.raises(ValueError, match="not allowed"):
+            a.move(other, lst, "items")
+
+
+class TestTreeUndoRedo:
+    def _undo_tree(self, tree):
+        from fluidframework_tpu.framework.undo_redo import (
+            SharedTreeUndoRedoHandler, UndoRedoStackManager)
+        stack = UndoRedoStackManager()
+        SharedTreeUndoRedoHandler(stack).attach(tree)
+        return stack
+
+    def test_undo_remove_restores_subtree(self):
+        seqr, (a, b) = make_trees()
+        p = a.insert("root", "items", value="p")
+        c1 = a.insert(p, "kids", value="c1")
+        a.insert(c1, "kids", value="g1")
+        seqr.process_all_messages()
+        stack = self._undo_tree(a)
+        a.remove(p)
+        stack.close_current_operation()
+        seqr.process_all_messages()
+        assert not b.has_node(p)
+        assert stack.undo_operation()
+        seqr.process_all_messages()
+        # the whole subtree is back, same ids, same shape
+        assert b.value_of(p) == "p"
+        assert b.children(p, "kids") == [c1]
+        assert len(digests([a, b])) == 1
+
+    def test_undo_redo_move_and_set_value(self):
+        seqr, (a, b) = make_trees()
+        x = a.insert("root", "items", value=1)
+        y = a.insert("root", "items", value=2, after=x)
+        seqr.process_all_messages()
+        stack = self._undo_tree(a)
+        a.move(y, "root", "items")            # y to front
+        stack.close_current_operation()
+        a.set_value(x, 99)
+        stack.close_current_operation()
+        seqr.process_all_messages()
+        assert b.children("root", "items") == [y, x]
+        stack.undo_operation()                 # undo set_value
+        stack.undo_operation()                 # undo move
+        seqr.process_all_messages()
+        assert b.children("root", "items") == [x, y]
+        assert b.value_of(x) == 1
+        stack.redo_operation()
+        stack.redo_operation()
+        seqr.process_all_messages()
+        assert b.children("root", "items") == [y, x]
+        assert b.value_of(x) == 99
+        assert len(digests([a, b])) == 1
+
+    def test_undo_transaction_is_atomic(self):
+        seqr, (a, b) = make_trees()
+        stack = self._undo_tree(a)
+        def edits(t):
+            p = t.insert("root", "items", value="p")
+            t.insert(p, "kids", value="c")
+            t.set_value(p, "p2")
+            return p
+        p = a.run_transaction(edits)
+        stack.close_current_operation()
+        seqr.process_all_messages()
+        assert stack.undo_operation()
+        seqr.process_all_messages()
+        assert not a.has_node(p) and not b.has_node(p)
+        assert stack.redo_operation()
+        seqr.process_all_messages()
+        assert b.value_of(p) == "p2" and len(b.children(p, "kids")) == 1
+        assert len(digests([a, b])) == 1
+
+    def test_undo_against_concurrent_edit_degrades(self):
+        """Undo of an insert whose node a remote replica already removed:
+        the inverse remove drops quietly; replicas stay converged."""
+        seqr, (a, b) = make_trees()
+        stack = self._undo_tree(a)
+        n = a.insert("root", "items", value="n")
+        stack.close_current_operation()
+        seqr.process_all_messages()
+        b.remove(n)
+        seqr.process_all_messages()
+        assert stack.undo_operation()
+        seqr.process_all_messages()
+        assert not a.has_node(n)
+        assert len(digests([a, b])) == 1
+
+
+# --------------------------------------------------------------- fuzz (txn)
+
+@pytest.mark.parametrize("seed", range(4))
+def test_tree_fuzz_with_transactions_and_undo(seed):
+    rng = random.Random(seed)
+    seqr, trees = make_trees(3)
+    from fluidframework_tpu.framework.undo_redo import (
+        SharedTreeUndoRedoHandler, UndoRedoStackManager)
+    stack = UndoRedoStackManager()
+    SharedTreeUndoRedoHandler(stack).attach(trees[0])
+
+    def random_node(t):
+        return rng.choice(sorted(t.kernel.view.nodes))
+
+    for _ in range(80):
+        t = rng.choice(trees)
+        r = rng.random()
+        try:
+            if r < 0.35:
+                t.insert(random_node(t), rng.choice("fg"),
+                         value=rng.randint(0, 9))
+            elif r < 0.5:
+                t.remove(random_node(t))
+            elif r < 0.65:
+                t.move(random_node(t), random_node(t), rng.choice("fg"))
+            elif r < 0.75:
+                t.set_value(random_node(t), rng.randint(0, 99))
+            elif r < 0.85:
+                def edits(tr):
+                    p = tr.insert(random_node(tr), "f", value="txn")
+                    tr.set_value(p, rng.randint(0, 9))
+                t.run_transaction(edits)
+            elif r < 0.93 and t is trees[0]:
+                stack.undo_operation()
+            elif t is trees[0]:
+                stack.redo_operation()
+        except (KeyError, ValueError, RuntimeError):
+            pass  # local-validity errors (move-into-self etc.) are fine
+        if t is trees[0]:
+            stack.close_current_operation()
+        if rng.random() < 0.3:
+            seqr.process_some(rng.randint(0, seqr.outstanding))
+    seqr.process_all_messages()
+    assert len(digests(trees)) == 1
+
+
+def test_undo_subtree_remove_with_child_moved_out():
+    """Undo of a subtree remove whose nested child was concurrently moved
+    out must NOT re-create the child's id (confirmed review repro: the
+    duplicate id corrupted sibling lists and crashed digest())."""
+    from fluidframework_tpu.framework.undo_redo import (
+        SharedTreeUndoRedoHandler, UndoRedoStackManager)
+    seqr, (a, b) = make_trees()
+    p = a.insert("root", "f", value="p")
+    c = a.insert(p, "g", value="c")
+    seqr.process_all_messages()
+    stack = UndoRedoStackManager()
+    SharedTreeUndoRedoHandler(stack).attach(a)
+    b.move(c, "root", "f")   # sequenced FIRST: c escapes the subtree
+    a.remove(p)              # a's pre-state still nests c under p
+    stack.close_current_operation()
+    seqr.process_all_messages()
+    assert a.has_node(c) and not a.has_node(p)
+    assert stack.undo_operation()
+    seqr.process_all_messages()
+    # p is back WITHOUT a duplicate c; c still lives at root
+    assert a.has_node(p) and a.children(p, "g") == []
+    assert a.kernel.view.nodes[c]["parent"] == "root"
+    assert len(digests([a, b])) == 1
+    # the crash path from the repro: removing p again must stay clean
+    a.remove(p)
+    seqr.process_all_messages()
+    assert a.has_node(c) and len(digests([a, b])) == 1
+    a.digest(); b.digest()
