@@ -235,7 +235,8 @@ class _Tree:
                     for spec in reversed(op["nodes"])]
         if kind == "remove":
             nid = op["id"]
-            if nid not in self.nodes:
+            # same guards as _remove: absent or root targets are no-ops
+            if nid not in self.nodes or nid == ROOT:
                 return []
             node = self.nodes[nid]
             return [{"op": "insert", "parent": node["parent"],
@@ -244,7 +245,7 @@ class _Tree:
                      "nodes": [self.subtree_spec(nid)]}]
         if kind == "move":
             nid = op["id"]
-            if nid not in self.nodes:
+            if nid not in self.nodes or nid == ROOT:
                 return []
             node = self.nodes[nid]
             return [{"op": "move", "id": nid, "parent": node["parent"],

@@ -183,3 +183,30 @@ class TensorMapStore:
 
     def digests(self) -> np.ndarray:
         return np.asarray(map_state_digest(self.state))
+
+    # ----------------------------------------------------- snapshot / resume
+
+    def snapshot(self) -> dict:
+        """Device→host gather + host interning tables (channel summarize();
+        resume = ``restore`` + tail replay through ``apply_batch``)."""
+        return {
+            "present": np.asarray(self.state.present).copy(),
+            "value": np.asarray(self.state.value).copy(),
+            "last_seq": np.asarray(self.state.last_seq).copy(),
+            "n_keys": self.n_keys,
+            "key_ids": [dict(m) for m in self._key_ids],
+            "values": self._interner.export(),
+        }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "TensorMapStore":
+        store = cls.__new__(cls)
+        store.n_docs = snap["present"].shape[0]
+        store.n_keys = snap["n_keys"]
+        store.state = MapState(
+            present=jnp.asarray(snap["present"]),
+            value=jnp.asarray(snap["value"]),
+            last_seq=jnp.asarray(snap["last_seq"]))
+        store._key_ids = [dict(m) for m in snap["key_ids"]]
+        store._interner = ValueInterner.restore(snap["values"])
+        return store

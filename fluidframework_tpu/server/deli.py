@@ -24,6 +24,7 @@ class NackReason(enum.IntEnum):
     CLIENT_SEQ_GAP = 1      # clientSeq jumped forward: lost op
     DUPLICATE = 2           # clientSeq replayed (at-least-once ingress): drop
     REF_SEQ_BELOW_MSN = 3   # op referenced state below the collab window
+    MALFORMED = 4           # op contents rejected before sequencing
 
 
 @dataclasses.dataclass

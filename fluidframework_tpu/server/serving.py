@@ -27,12 +27,139 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..ops.map_kernel import TensorMapStore
+from ..ops.schema import OpKind
 from ..ops.string_store import TensorStringStore
-from .deli import DeliSequencer, Nack
+from .deli import DeliSequencer, Nack, NackReason
 from .oplog import PartitionedLog, partition_of
 
 
-class StringServingEngine:
+class ServingEngineBase:
+    """The DDS-agnostic half of a serving engine: Deli sequencing, the
+    durable partitioned log, doc-row membership, window-floor tracking, and
+    the adaptive batch window. Subclasses own the device store(s): they
+    implement ``_enqueue``/``flush``/``compact`` and summary/recovery."""
+
+    def __init__(self, batch_window: int = 64, n_partitions: int = 8,
+                 compact_every: int = 16,
+                 log: Optional[PartitionedLog] = None):
+        self.deli = DeliSequencer()
+        self.log = log if log is not None else PartitionedLog(n_partitions)
+        self.batch_window = batch_window
+        self.compact_every = compact_every
+        self._doc_rows: Dict[str, int] = {}
+        self._queue: List[Tuple[int, SequencedDocumentMessage]] = []
+        self._flushes_since_compact = 0
+        self._min_seq: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ membership
+
+    def doc_row(self, doc_id: str) -> int:
+        if doc_id not in self._doc_rows:
+            if len(self._doc_rows) >= self.n_docs:
+                raise KeyError(f"document capacity {self.n_docs} exhausted")
+            self._doc_rows[doc_id] = len(self._doc_rows)
+        return self._doc_rows[doc_id]
+
+    def connect(self, doc_id: str, client_id: int
+                ) -> SequencedDocumentMessage:
+        # row allocation is lazy (first op/read), so a JOIN never pins the
+        # doc to a tier it should not land on
+        msg = self.deli.client_join(doc_id, client_id)
+        self._log_append(doc_id, msg)
+        return msg
+
+    def disconnect(self, doc_id: str, client_id: int
+                   ) -> Optional[SequencedDocumentMessage]:
+        msg = self.deli.client_leave(doc_id, client_id)
+        if msg is not None:
+            self._log_append(doc_id, msg)
+        return msg
+
+    # --------------------------------------------------------------- ingress
+
+    def submit(self, doc_id: str, client_id: int, client_seq: int,
+               ref_seq: int, contents: Any
+               ) -> Tuple[Optional[SequencedDocumentMessage], Optional[Nack]]:
+        """Ingest one raw op. Returns (sequenced message, None) — the
+        broadcast/ack — or (None, nack). Malformed contents are nacked
+        BEFORE sequencing/logging: an acked-and-logged op the flush path
+        cannot apply would poison the engine and its recovery replay."""
+        if not self._valid_op(contents):
+            return None, Nack(doc_id, client_id, client_seq,
+                              NackReason.MALFORMED)
+        msg, nack = self.deli.sequence(
+            doc_id, client_id, client_seq, ref_seq, MessageType.OP, contents)
+        if nack is not None:
+            return None, nack
+        self._log_append(doc_id, msg)
+        self._enqueue(doc_id, msg)
+        self._min_seq[doc_id] = msg.min_seq
+        if self._queued() >= self.batch_window:
+            self.flush()
+        return msg, None
+
+    def _valid_op(self, contents: Any) -> bool:
+        """Subclasses reject op shapes their flush path cannot apply."""
+        return True
+
+    def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
+        self.log.append(partition_of(doc_id, self.log.n_partitions), msg)
+
+    def _enqueue(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
+        self._queue.append((self.doc_row(doc_id), msg))
+
+    def _queued(self) -> int:
+        return len(self._queue)
+
+    def _after_flush(self, n: int) -> None:
+        if n:
+            self._flushes_since_compact += 1
+            if self._flushes_since_compact >= self.compact_every:
+                self.compact()
+
+    def compact(self) -> None:
+        self._flushes_since_compact = 0
+
+    # ----------------------------------------------------- summary / recovery
+    # The engine-agnostic half of the single recovery primitive (summary +
+    # log-tail replay through the same apply path). Subclass summarize()
+    # merges _base_summary() with its store snapshot(s); subclass load()
+    # calls _restore_base() then _replay_tail().
+
+    def _base_summary(self) -> dict:
+        return {
+            "deli": self.deli.checkpoint(),
+            "log_offsets": [self.log.size(p)
+                            for p in range(self.log.n_partitions)],
+            "doc_rows": dict(self._doc_rows),
+            "min_seq": dict(self._min_seq),
+        }
+
+    def _restore_base(self, summary: dict) -> None:
+        self.deli = DeliSequencer.restore(summary["deli"])
+        self._doc_rows = dict(summary["doc_rows"])
+        self._min_seq = dict(summary["min_seq"])
+
+    def _replay_tail(self, summary: dict, control_hook=None) -> None:
+        """Replay EVERY tail message through the sequencer state (so
+        resumed sequencing continues past the tail, not from the stale
+        checkpoint); JOINs re-register clients (a join-only doc must
+        survive recovery); OPs queue for the device merge. A
+        ``control_hook(msg) -> True`` consumes engine-specific control
+        records before they reach the stores."""
+        for p in range(self.log.n_partitions):
+            for msg in self.log.read(p, from_offset=summary["log_offsets"][p]):
+                self.deli.replay(msg)
+                if control_hook is not None and control_hook(msg):
+                    continue
+                if msg.type == MessageType.OP:
+                    self._enqueue(msg.doc_id, msg)
+                    self._min_seq[msg.doc_id] = msg.min_seq
+        self._queue.sort(key=lambda dm: dm[1].seq)
+
+
+class StringServingEngine(ServingEngineBase):
     """Sequencer + durable log + batched device merge for many documents."""
 
     def __init__(self, n_docs: int, capacity: int = 256, n_props: int = 4,
@@ -42,8 +169,7 @@ class StringServingEngine:
                  store: Optional[TensorStringStore] = None,
                  mega_docs: int = 0, mega_capacity_per_shard: int = 256,
                  mega_store=None):
-        self.deli = DeliSequencer()
-        self.log = log if log is not None else PartitionedLog(n_partitions)
+        super().__init__(batch_window, n_partitions, compact_every, log)
         self.store = store if store is not None \
             else TensorStringStore(n_docs, capacity, n_props)
         # mega tier: documents too long for one chip's slot budget are
@@ -55,25 +181,15 @@ class StringServingEngine:
             self.mega_store = MegaDocStringStore(mega_docs,
                                                  mega_capacity_per_shard)
         self.n_docs = n_docs
-        self.batch_window = batch_window
-        self.compact_every = compact_every
-        self._doc_rows: Dict[str, int] = {}
         self._mega_rows: Dict[str, int] = {}
-        self._queue: List[Tuple[int, SequencedDocumentMessage]] = []
         self._mega_queue: List[Tuple[int, SequencedDocumentMessage]] = []
-        self._flushes_since_compact = 0
-        self._min_seq: Dict[str, int] = {}
 
     # ------------------------------------------------------------ membership
 
     def doc_row(self, doc_id: str) -> int:
         if doc_id in self._mega_rows:
             return self._mega_rows[doc_id]
-        if doc_id not in self._doc_rows:
-            if len(self._doc_rows) >= self.n_docs:
-                raise KeyError(f"document capacity {self.n_docs} exhausted")
-            self._doc_rows[doc_id] = len(self._doc_rows)
-        return self._doc_rows[doc_id]
+        return super().doc_row(doc_id)
 
     def mark_mega(self, doc_id: str) -> None:
         """Route this document to the segment-axis-sharded mega tier (must
@@ -96,43 +212,17 @@ class StringServingEngine:
             raise KeyError("mega-doc capacity exhausted")
         self._mega_rows[doc_id] = len(self._mega_rows)
 
-    def connect(self, doc_id: str, client_id: int
-                ) -> SequencedDocumentMessage:
-        # row allocation is lazy (first op/read): a JOIN must not pin the
-        # doc to the flat tier before mark_mega can run
-        msg = self.deli.client_join(doc_id, client_id)
-        self._log_append(doc_id, msg)
-        return msg
-
-    def disconnect(self, doc_id: str, client_id: int
-                   ) -> Optional[SequencedDocumentMessage]:
-        msg = self.deli.client_leave(doc_id, client_id)
-        if msg is not None:
-            self._log_append(doc_id, msg)
-        return msg
-
     # --------------------------------------------------------------- ingress
 
-    def submit(self, doc_id: str, client_id: int, client_seq: int,
-               ref_seq: int, contents: Any
-               ) -> Tuple[Optional[SequencedDocumentMessage], Optional[Nack]]:
-        """Ingest one raw merge-tree op (the ``mt`` dicts of SequenceClient).
-        Returns (sequenced message, None) — the broadcast/ack — or
-        (None, nack)."""
-        msg, nack = self.deli.sequence(
-            doc_id, client_id, client_seq, ref_seq, MessageType.OP, contents)
-        if nack is not None:
-            return None, nack
-        self._log_append(doc_id, msg)
+    def _enqueue(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
         row = self.doc_row(doc_id)
         if doc_id in self._mega_rows:
             self._mega_queue.append((row, msg))
         else:
             self._queue.append((row, msg))
-        self._min_seq[doc_id] = msg.min_seq
-        if len(self._queue) + len(self._mega_queue) >= self.batch_window:
-            self.flush()
-        return msg, None
+
+    def _queued(self) -> int:
+        return len(self._queue) + len(self._mega_queue)
 
     def heartbeat(self, doc_id: str, client_id: int, ref_seq: int) -> None:
         """NOOP: advances the client's refSeq (and the doc's MSN) so zamboni
@@ -153,24 +243,18 @@ class StringServingEngine:
                     self.flush()
                     store.advance_min_seq(row, msg.min_seq)
 
-    def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
-        self.log.append(partition_of(doc_id, self.log.n_partitions), msg)
-
     # ----------------------------------------------------------- device side
 
     def flush(self) -> int:
         """Merge the queued window on device in one batched apply per tier."""
-        n = len(self._queue) + len(self._mega_queue)
+        n = self._queued()
         if self._queue:
             self.store.apply_messages(self._queue)
             self._queue.clear()
         if self._mega_queue:
             self.mega_store.apply_messages(self._mega_queue)
             self._mega_queue.clear()
-        if n:
-            self._flushes_since_compact += 1
-            if self._flushes_since_compact >= self.compact_every:
-                self.compact()
+        self._after_flush(n)
         return n
 
     def compact(self) -> None:
@@ -184,7 +268,7 @@ class StringServingEngine:
             for doc_id, row in self._mega_rows.items():
                 ms[row] = self._min_seq.get(doc_id, 0)
             self.mega_store.compact(ms)
-        self._flushes_since_compact = 0
+        super().compact()
 
     # ----------------------------------------------------------------- reads
 
@@ -222,25 +306,19 @@ class StringServingEngine:
         snapshot, sequencer checkpoint, per-partition log offsets, doc map."""
         self.flush()
         self.compact()
-        return {
-            "store": self.store.snapshot(),
-            "mega_store": self.mega_store.snapshot()
-            if self.mega_store is not None else None,
-            "deli": self.deli.checkpoint(),
-            "log_offsets": [self.log.size(p)
-                            for p in range(self.log.n_partitions)],
-            "doc_rows": dict(self._doc_rows),
-            "mega_rows": dict(self._mega_rows),
-            "min_seq": dict(self._min_seq),
-        }
+        summary = self._base_summary()
+        summary["store"] = self.store.snapshot()
+        summary["mega_store"] = self.mega_store.snapshot() \
+            if self.mega_store is not None else None
+        summary["mega_rows"] = dict(self._mega_rows)
+        return summary
 
     @classmethod
     def load(cls, summary: dict, log: PartitionedLog,
              **kwargs) -> "StringServingEngine":
         """Resume from a summary + the durable log: restore the device
-        state, restore the sequencer, then replay the log tail (everything
-        appended after the summary's offsets) through the same apply
-        kernels — the single recovery primitive."""
+        state, restore the sequencer, then replay the log tail through the
+        same apply kernels — the single recovery primitive."""
         store = TensorStringStore.restore(summary["store"])
         mega = None
         if summary.get("mega_store") is not None:
@@ -248,31 +326,87 @@ class StringServingEngine:
             mega = MegaDocStringStore.restore(summary["mega_store"])
         engine = cls(store.n_docs, store.capacity, store.n_props,
                      log=log, store=store, mega_store=mega, **kwargs)
-        engine.deli = DeliSequencer.restore(summary["deli"])
-        engine._doc_rows = dict(summary["doc_rows"])
+        engine._restore_base(summary)
         engine._mega_rows = dict(summary.get("mega_rows", {}))
-        engine._min_seq = dict(summary["min_seq"])
-        # replay EVERY tail message through the sequencer state (so resumed
-        # sequencing continues past the tail, not from the stale checkpoint);
-        # JOINs register doc rows (a join-only doc must survive recovery),
-        # OPs queue for the device merge
-        for p in range(log.n_partitions):
-            for msg in log.read(p, from_offset=summary["log_offsets"][p]):
-                engine.deli.replay(msg)
-                if msg.type == MessageType.PROPOSAL and \
-                        isinstance(msg.contents, dict) and \
-                        msg.contents.get("markMega"):
-                    if msg.doc_id not in engine._mega_rows:
-                        engine._register_mega(msg.doc_id)  # no re-log
-                    continue  # control record: not for the stores
-                if msg.type == MessageType.OP:
-                    row = engine.doc_row(msg.doc_id)
-                    if msg.doc_id in engine._mega_rows:
-                        engine._mega_queue.append((row, msg))
-                    else:
-                        engine._queue.append((row, msg))
-                    engine._min_seq[msg.doc_id] = msg.min_seq
-        engine._queue.sort(key=lambda dm: dm[1].seq)
+
+        def mark_mega_hook(msg):
+            if msg.type == MessageType.PROPOSAL and \
+                    isinstance(msg.contents, dict) and \
+                    msg.contents.get("markMega"):
+                if msg.doc_id not in engine._mega_rows:
+                    engine._register_mega(msg.doc_id)  # no re-log
+                return True  # control record: not for the stores
+            return False
+
+        engine._replay_tail(summary, control_hook=mark_mega_hook)
         engine._mega_queue.sort(key=lambda dm: dm[1].seq)
+        engine.flush()
+        return engine
+
+
+class MapServingEngine(ServingEngineBase):
+    """Serving engine for SharedMap documents: same Deli + durable log +
+    batch-window pipeline as the string engine, over the batched LWW map
+    kernel (BASELINE config #2 as a service). Ops are the SharedMap wire
+    dicts: {"op": "set"|"delete"|"clear", "key", "value"}."""
+
+    def __init__(self, n_docs: int, n_keys: int = 64,
+                 batch_window: int = 64, n_partitions: int = 8,
+                 log: Optional[PartitionedLog] = None,
+                 store: Optional[TensorMapStore] = None):
+        super().__init__(batch_window, n_partitions, log=log)
+        self.store = store if store is not None \
+            else TensorMapStore(n_docs, n_keys)
+        self.n_docs = n_docs
+
+    # ----------------------------------------------------------- device side
+
+    _KINDS = {"set": OpKind.MAP_SET, "delete": OpKind.MAP_DELETE,
+              "clear": OpKind.MAP_CLEAR}
+
+    def _valid_op(self, contents: Any) -> bool:
+        return (isinstance(contents, dict)
+                and contents.get("op") in self._KINDS
+                and (contents["op"] == "clear" or
+                     isinstance(contents.get("key"), str)))
+
+    def flush(self) -> int:
+        n = len(self._queue)
+        if self._queue:
+            self.store.apply_batch(
+                (row, self._KINDS[m.contents["op"]],
+                 m.contents.get("key"), m.contents.get("value"), m.seq)
+                for row, m in self._queue)
+            self._queue.clear()
+        self._after_flush(n)
+        return n
+
+    # ----------------------------------------------------------------- reads
+
+    def read_doc(self, doc_id: str) -> dict:
+        self.flush()
+        return self.store.read_doc(self.doc_row(doc_id))
+
+    def get(self, doc_id: str, key: str, default=None):
+        return self.read_doc(doc_id).get(key, default)
+
+    # ----------------------------------------------------- summary / recovery
+
+    def summarize(self) -> dict:
+        self.flush()
+        summary = self._base_summary()
+        summary["store"] = self.store.snapshot()
+        return summary
+
+    @classmethod
+    def load(cls, summary: dict, log: PartitionedLog,
+             **kwargs) -> "MapServingEngine":
+        """Summary + tail replay through the same apply path (the single
+        recovery primitive, as in the string engine)."""
+        store = TensorMapStore.restore(summary["store"])
+        engine = cls(store.n_docs, store.n_keys, log=log, store=store,
+                     **kwargs)
+        engine._restore_base(summary)
+        engine._replay_tail(summary)
         engine.flush()
         return engine

@@ -181,3 +181,42 @@ def test_map_undo_restores_stored_none():
     assert stack.undo_operation()
     seqr.process_all_messages()
     assert not a.has("k")
+
+
+def test_map_engine_nacks_malformed_op_before_logging():
+    """A malformed op must be nacked BEFORE sequencing/logging: an
+    acked-and-logged op the flush path cannot apply bricks the engine and
+    its recovery replay (confirmed review repro)."""
+    from fluidframework_tpu.server.deli import NackReason
+    from fluidframework_tpu.server.oplog import PartitionedLog
+    from fluidframework_tpu.server.serving import MapServingEngine
+    log = PartitionedLog(2)
+    engine = MapServingEngine(n_docs=1, log=log)
+    engine.connect("a", 1)
+    for bad in ({"op": "bogus"}, {"op": "set", "key": 7}, "junk", None):
+        msg, nack = engine.submit("a", 1, 1, 0, bad)
+        assert msg is None and nack.reason == NackReason.MALFORMED
+    # the engine keeps working, nothing poisoned the log
+    msg, nack = engine.submit("a", 1, 1, 0,
+                              {"op": "set", "key": "x", "value": 1})
+    assert nack is None
+    assert engine.read_doc("a") == {"x": 1}
+    engine2 = MapServingEngine.load(engine.summarize(), log)
+    assert engine2.read_doc("a") == {"x": 1}
+
+
+def test_tree_inverse_guards_root_ops_with_undo_attached():
+    """remove(root)/move(root) are benign no-ops; attaching an undo handler
+    must not turn them into crashes (confirmed review repro: inverse_of
+    raised KeyError(None) computing the root's prev sibling)."""
+    from fluidframework_tpu.framework.undo_redo import (
+        SharedTreeUndoRedoHandler, UndoRedoStackManager)
+    from fluidframework_tpu.models import SharedTree
+    seqr = MockSequencer()
+    t = create_connected_dds(seqr, SharedTree, "t")
+    stack = UndoRedoStackManager()
+    SharedTreeUndoRedoHandler(stack).attach(t)
+    t.remove("root")
+    t.move("root", "root", "f")
+    seqr.process_all_messages()
+    assert t.has_node("root")
