@@ -14,6 +14,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 
 TARGETS = {
     "libdeli.so": ["sequencer.cpp"],
+    "liboplog.so": ["oplog.cpp"],
 }
 
 

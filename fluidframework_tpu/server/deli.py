@@ -25,6 +25,7 @@ class NackReason(enum.IntEnum):
     DUPLICATE = 2           # clientSeq replayed (at-least-once ingress): drop
     REF_SEQ_BELOW_MSN = 3   # op referenced state below the collab window
     MALFORMED = 4           # op contents rejected before sequencing
+    CAPACITY = 5            # engine capacity (docs/keys) exhausted
 
 
 @dataclasses.dataclass

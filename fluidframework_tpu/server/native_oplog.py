@@ -1,0 +1,183 @@
+"""ctypes binding for the native durable op log + the binary op codec.
+
+The C++ log (``native/oplog.cpp``) owns the IO hot path: CRC-framed
+append-only partition segments with torn-tail truncation on open — the
+durable-ordered-log role Kafka plays in the reference (SURVEY.md §5.8).
+This module adds the wire codec (fixed struct header + JSON contents blob,
+the ``ISequencedDocumentMessage`` analog of SURVEY.md §7.2) and exposes the
+same API as ``oplog.PartitionedLog`` so the serving engines can take either
+(``NativePartitionedLog`` survives process crashes; the Python log is
+in-memory with optional JSONL spill).
+
+Falls back to nothing: ``available()`` says whether the library built; the
+serving engines default to the Python log.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import struct
+from typing import Any, Callable, List, Optional
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..native.build import ensure_built
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = ensure_built("liboplog.so")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.oplog_open.restype = ctypes.c_void_p
+    lib.oplog_open.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+    lib.oplog_close.argtypes = [ctypes.c_void_p]
+    lib.oplog_append.restype = ctypes.c_int64
+    lib.oplog_append.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                 ctypes.c_char_p, ctypes.c_int64]
+    lib.oplog_sync.restype = ctypes.c_int32
+    lib.oplog_sync.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.oplog_size.restype = ctypes.c_int64
+    lib.oplog_size.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.oplog_record_len.restype = ctypes.c_int64
+    lib.oplog_record_len.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                     ctypes.c_int64]
+    lib.oplog_read.restype = ctypes.c_int64
+    lib.oplog_read.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                               ctypes.c_int64,
+                               ctypes.POINTER(ctypes.c_uint8),
+                               ctypes.c_int64]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ------------------------------------------------------------------- codec
+# Fixed header (little-endian): client_id, client_seq, ref_seq, seq,
+# min_seq as int64, type as int32, doc_id length as int32 — then doc_id
+# bytes, then the JSON-encoded contents blob. The ints the device kernels
+# consume ride in fixed slots; only the variable payload needs JSON.
+
+_HEADER = struct.Struct("<qqqqqii")
+
+
+def encode_message(msg: SequencedDocumentMessage) -> bytes:
+    doc = msg.doc_id.encode()
+    contents = json.dumps(
+        {"c": msg.contents, "a": msg.address, "m": msg.metadata},
+        default=str).encode()
+    return _HEADER.pack(msg.client_id, msg.client_seq, msg.ref_seq,
+                        msg.seq, msg.min_seq, int(msg.type),
+                        len(doc)) + doc + contents
+
+
+def decode_message(data: bytes) -> SequencedDocumentMessage:
+    (client_id, client_seq, ref_seq, seq, min_seq, mtype,
+     doc_len) = _HEADER.unpack_from(data)
+    doc_id = data[_HEADER.size:_HEADER.size + doc_len].decode()
+    blob = json.loads(data[_HEADER.size + doc_len:])
+    msg = SequencedDocumentMessage(
+        doc_id=doc_id, client_id=client_id, client_seq=client_seq,
+        ref_seq=ref_seq, seq=seq, min_seq=min_seq,
+        type=MessageType(mtype), contents=blob["c"],
+        metadata=blob.get("m"), address=blob.get("a"))
+    return msg
+
+
+# --------------------------------------------------------------------- log
+
+
+class NativePartitionedLog:
+    """Durable PartitionedLog on the C++ segment files: same API surface
+    (append/read/size/subscribe), crash-safe — reopen the same directory
+    and every record before a torn tail is back."""
+
+    def __init__(self, directory: str, n_partitions: int = 8):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native oplog library unavailable")
+        import os
+        os.makedirs(directory, exist_ok=True)
+        self._lib = lib
+        self.n_partitions = n_partitions
+        self.directory = directory
+        self._h = lib.oplog_open(directory.encode(), n_partitions)
+        if not self._h:
+            raise RuntimeError(f"oplog_open failed for {directory}")
+        self._subs: List[List[Callable[[int, int, Any], None]]] = [
+            [] for _ in range(n_partitions)]
+        # per-partition locks, as in oplog.PartitionedLog: the C side's
+        # fseek/fwrite pairs and the shared FILE* cursor are not
+        # thread-safe — an unlocked concurrent append would tear frames,
+        # which the CRC scan then silently truncates on reopen
+        import threading
+        self._plocks = [threading.RLock() for _ in range(n_partitions)]
+
+    def append(self, partition: int, record: Any) -> int:
+        data = encode_message(record) \
+            if isinstance(record, SequencedDocumentMessage) \
+            else json.dumps(record, default=str).encode()
+        tag = b"M" if isinstance(record, SequencedDocumentMessage) else b"J"
+        with self._plocks[partition]:
+            offset = self._lib.oplog_append(self._h, partition, tag + data,
+                                            len(data) + 1)
+            if offset < 0:
+                raise IOError(f"append to partition {partition} failed")
+            for fn in list(self._subs[partition]):
+                fn(partition, offset, record)
+        return offset
+
+    def sync(self, partition: Optional[int] = None) -> None:
+        """fsync barrier (group-commit point) for one or all partitions."""
+        parts = range(self.n_partitions) if partition is None else (partition,)
+        for p in parts:
+            with self._plocks[p]:
+                if self._lib.oplog_sync(self._h, p) != 0:
+                    raise IOError(f"fsync of partition {p} failed")
+
+    def size(self, partition: int) -> int:
+        return int(self._lib.oplog_size(self._h, partition))
+
+    def _record(self, partition: int, offset: int) -> Any:
+        with self._plocks[partition]:
+            n = self._lib.oplog_record_len(self._h, partition, offset)
+            if n < 0:
+                raise IndexError((partition, offset))
+            buf = (ctypes.c_uint8 * n)()
+            got = self._lib.oplog_read(self._h, partition, offset, buf, n)
+            if got != n:
+                raise IOError(f"read p{partition}@{offset} failed (CRC?)")
+        raw = bytes(buf)
+        return decode_message(raw[1:]) if raw[:1] == b"M" \
+            else json.loads(raw[1:])
+
+    def read(self, partition: int, from_offset: int = 0):
+        for off in range(from_offset, self.size(partition)):
+            yield self._record(partition, off)
+
+    def subscribe(self, partition: int,
+                  fn: Callable[[int, int, Any], None],
+                  from_offset: int = 0) -> None:
+        with self._plocks[partition]:  # no append between backlog & register
+            for off in range(from_offset, self.size(partition)):
+                fn(partition, off, self._record(partition, off))
+            self._subs[partition].append(fn)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.oplog_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

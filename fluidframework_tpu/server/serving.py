@@ -22,6 +22,7 @@ latency exactly like the reference's outbox flush policy.
 
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -82,12 +83,18 @@ class ServingEngineBase:
                ref_seq: int, contents: Any
                ) -> Tuple[Optional[SequencedDocumentMessage], Optional[Nack]]:
         """Ingest one raw op. Returns (sequenced message, None) — the
-        broadcast/ack — or (None, nack). Malformed contents are nacked
-        BEFORE sequencing/logging: an acked-and-logged op the flush path
-        cannot apply would poison the engine and its recovery replay."""
+        broadcast/ack — or (None, nack). Malformed contents and capacity
+        overflows are nacked BEFORE sequencing/logging: an acked-and-logged
+        op the flush path cannot apply would poison the engine AND its
+        recovery replay (the log is replayed through the same path)."""
         if not self._valid_op(contents):
             return None, Nack(doc_id, client_id, client_seq,
                               NackReason.MALFORMED)
+        try:
+            self._admit(doc_id, contents)
+        except KeyError:
+            return None, Nack(doc_id, client_id, client_seq,
+                              NackReason.CAPACITY)
         msg, nack = self.deli.sequence(
             doc_id, client_id, client_seq, ref_seq, MessageType.OP, contents)
         if nack is not None:
@@ -102,6 +109,12 @@ class ServingEngineBase:
     def _valid_op(self, contents: Any) -> bool:
         """Subclasses reject op shapes their flush path cannot apply."""
         return True
+
+    def _admit(self, doc_id: str, contents: Any) -> None:
+        """Reserve the capacity the op will need at flush (doc row here;
+        subclasses add store-specific reservations like key slots). Raises
+        KeyError on exhaustion → the op is nacked before it is logged."""
+        self.doc_row(doc_id)
 
     def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
         self.log.append(partition_of(doc_id, self.log.n_partitions), msg)
@@ -365,10 +378,23 @@ class MapServingEngine(ServingEngineBase):
               "clear": OpKind.MAP_CLEAR}
 
     def _valid_op(self, contents: Any) -> bool:
-        return (isinstance(contents, dict)
+        if not (isinstance(contents, dict)
                 and contents.get("op") in self._KINDS
                 and (contents["op"] == "clear" or
-                     isinstance(contents.get("key"), str)))
+                     isinstance(contents.get("key"), str))):
+            return False
+        if contents["op"] == "set":
+            try:  # the flush path JSON-interns values: reject unserializable
+                json.dumps(contents.get("value"))
+            except (TypeError, ValueError):
+                return False
+        return True
+
+    def _admit(self, doc_id: str, contents: Any) -> None:
+        row = self.doc_row(doc_id)
+        if contents["op"] != "clear":
+            self.store.key_slot(row, contents["key"])  # reserve (KeyError
+            # on key-capacity exhaustion → CAPACITY nack before logging)
 
     def flush(self) -> int:
         n = len(self._queue)

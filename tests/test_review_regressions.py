@@ -220,3 +220,64 @@ def test_tree_inverse_guards_root_ops_with_undo_attached():
     t.move("root", "root", "f")
     seqr.process_all_messages()
     assert t.has_node("root")
+
+
+def test_engine_capacity_nacked_before_logging():
+    """Capacity overflows (doc rows, key slots) and unserializable values
+    must be nacked BEFORE the op reaches the durable log — a logged op the
+    flush path cannot apply bricks the engine and all recovery (confirmed
+    review repros)."""
+    from fluidframework_tpu.server.deli import NackReason
+    from fluidframework_tpu.server.oplog import PartitionedLog
+    from fluidframework_tpu.server.serving import MapServingEngine
+    log = PartitionedLog(2)
+    engine = MapServingEngine(n_docs=1, n_keys=2, log=log)
+    engine.connect("a", 1)
+    engine.submit("a", 1, 1, 0, {"op": "set", "key": "k0", "value": 0})
+    # doc capacity: a second doc's op is nacked, not logged
+    engine.connect("b", 1)
+    msg, nack = engine.submit("b", 1, 1, 0,
+                              {"op": "set", "key": "k", "value": 1})
+    assert msg is None and nack.reason == NackReason.CAPACITY
+    # key capacity: third distinct key nacked, not logged
+    engine.submit("a", 1, 2, 0, {"op": "set", "key": "k1", "value": 1})
+    msg, nack = engine.submit("a", 1, 3, 0,
+                              {"op": "set", "key": "k2", "value": 2})
+    assert msg is None and nack.reason == NackReason.CAPACITY
+    # unserializable value nacked as malformed
+    msg, nack = engine.submit("a", 1, 3, 0,
+                              {"op": "set", "key": "k0", "value": object()})
+    assert msg is None and nack.reason == NackReason.MALFORMED
+    # engine healthy; recovery replays the log without poison
+    assert engine.read_doc("a") == {"k0": 0, "k1": 1}
+    engine2 = MapServingEngine.load(engine.summarize(), log)
+    assert engine2.read_doc("a") == {"k0": 0, "k1": 1}
+
+
+def test_native_log_concurrent_appends_keep_framing():
+    """Two threads appending to one partition must not tear frames (the
+    reopen CRC scan would silently truncate acked records)."""
+    import tempfile
+    import threading
+    from fluidframework_tpu.server.native_oplog import (
+        NativePartitionedLog, available)
+    if not available():
+        import pytest
+        pytest.skip("native oplog not built")
+    d = tempfile.mkdtemp()
+    log = NativePartitionedLog(d, 1)
+    N = 200
+    def writer(tag):
+        for i in range(N):
+            log.append(0, {"t": tag, "i": i, "pad": "x" * (i % 50)})
+    threads = [threading.Thread(target=writer, args=(t,)) for t in "ab"]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    log.sync()
+    log.close()
+    back = list(NativePartitionedLog(d, 1).read(0))
+    assert len(back) == 2 * N  # nothing torn, nothing truncated
+    for tag in "ab":
+        assert [r["i"] for r in back if r["t"] == tag] == list(range(N))
