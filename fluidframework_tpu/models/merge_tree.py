@@ -627,6 +627,9 @@ class MergeTree:
                 "removedSeq": seg.removed_seq if removed else None,
                 "removers": [c for c in seg.removers] if removed else [],
                 "props": dict(seg.props),
+                # payload identity: the matrix permutation axes encode
+                # row/col KEYS through handles, so snapshots must carry them
+                "handle": list(seg.handle),
             })
         return {"minSeq": self.min_seq, "segments": out}
 
@@ -643,6 +646,7 @@ class MergeTree:
                 removed_seq=rec["removedSeq"],
                 removers=list(rec["removers"]),
                 props=dict(rec["props"]),
+                handle=tuple(rec.get("handle", (0, 0))),
             )
             tree.segments.append(seg)
         return tree

@@ -160,6 +160,18 @@ class TensorMatrixStore:
                 self.state, jnp.asarray(key), jnp.asarray(seq),
                 jnp.asarray(val), self.fww)
 
+    def read_cell(self, cell: Tuple):
+        """One cell's value without the full-table readback: the table is
+        key-sorted on device, so a searchsorted probe + two scalar reads
+        replace the O(capacity) transfer ``read_cells`` pays."""
+        cid = self._cell_ids.get(cell)
+        if cid is None:
+            return None
+        idx = int(jnp.searchsorted(self.state.key, jnp.int32(cid)))
+        if idx >= self.capacity or int(self.state.key[idx]) != cid:
+            return None
+        return self._interner.value(int(self.state.value[idx]))
+
     def read_cells(self) -> dict:
         """{(rowKey, colKey): value} for all live cells."""
         keys = np.asarray(self.state.key)
@@ -171,3 +183,40 @@ class TensorMatrixStore:
 
     def overflowed(self) -> bool:
         return bool(np.asarray(self.state.overflow))
+
+    # ----------------------------------------------------- snapshot / resume
+
+    def snapshot(self) -> dict:
+        return {
+            "key": np.asarray(self.state.key).copy(),
+            "seq": np.asarray(self.state.seq).copy(),
+            "value": np.asarray(self.state.value).copy(),
+            "count": int(np.asarray(self.state.count)),
+            "overflow": int(np.asarray(self.state.overflow)),
+            "batch": self.batch,
+            "cell_ids": list(self._cell_ids.items()),
+            "values": self._interner.export(),
+            "fww": self.fww,
+        }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "TensorMatrixStore":
+        store = cls.__new__(cls)
+        store.capacity = snap["key"].shape[0]
+        store.batch = snap["batch"]
+        store.state = MatrixCellState(
+            key=jnp.asarray(snap["key"]), seq=jnp.asarray(snap["seq"]),
+            value=jnp.asarray(snap["value"]),
+            count=jnp.asarray(snap["count"], jnp.int32),
+            overflow=jnp.asarray(snap["overflow"], jnp.int32))
+        store._cell_ids = {tuple_key(k): v for k, v in snap["cell_ids"]}
+        store._interner = ValueInterner.restore(snap["values"])
+        store.fww = snap["fww"]
+        return store
+
+
+def tuple_key(k):
+    """Recursively re-tuple a cell identity (snapshot transports may have
+    turned nested tuples into lists)."""
+    return tuple(tuple_key(x) if isinstance(x, (list, tuple)) else x
+                 for x in k)

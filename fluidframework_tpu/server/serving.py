@@ -436,3 +436,221 @@ class MapServingEngine(ServingEngineBase):
         engine._replay_tail(summary)
         engine.flush()
         return engine
+
+
+class MatrixServingEngine(ServingEngineBase):
+    """Serving engine for SharedMatrix documents.
+
+    Division of labor (SURVEY.md §2.4): the thin permutation logic — row/col
+    inserts/removes and position→key resolution at each op's (ref_seq,
+    client) perspective — runs on host observer axes (MergeTree-backed,
+    exactly the DDS's rules); the cell-write volume merges on device in the
+    sort-based cell table, shared across all documents by interning
+    (doc, rowKey, colKey) identities.
+
+    FWW fidelity: the DDS's first-writer-wins rejects a write only when the
+    writer had NOT seen the current value and is not its author — unlike
+    the kernel's batch-level "first ever wins" flag. The engine therefore
+    tracks per-cell (seq, writer) host-side and filters FWW losers BEFORE
+    device apply; the device always merges LWW, and the surviving stream's
+    latest write is exactly the DDS's answer.
+    """
+
+    _MX = {"insRow", "insCol", "rmRow", "rmCol", "setCell", "policy"}
+
+    def __init__(self, n_docs: int, cell_capacity: int = 1 << 16,
+                 batch_window: int = 64, n_partitions: int = 8,
+                 log: Optional[PartitionedLog] = None,
+                 store=None):
+        from ..ops.matrix_kernel import TensorMatrixStore
+        super().__init__(batch_window, n_partitions, log=log)
+        self.store = store if store is not None \
+            else TensorMatrixStore(cell_capacity)
+        self.n_docs = n_docs
+        self._axes: Dict[int, tuple] = {}       # row -> (rows, cols)
+        self._fww: Dict[int, bool] = {}
+        # per-doc {cell: (seq, writer)} — the FWW visibility metadata
+        self._cell_meta: Dict[int, Dict] = {}
+
+    # structural bound on one axis op (an insert allocates count slots on
+    # the host axis — an unbounded count is a memory-exhaustion vector)
+    MAX_AXIS_COUNT = 1 << 20
+
+    @staticmethod
+    def _is_nat(v, lo=0) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= lo
+
+    def _valid_op(self, contents: Any) -> bool:
+        """Full structural validation BEFORE sequencing/logging: every field
+        the flush path touches must have the type/range it assumes — a
+        logged op that raises in flush poisons the engine and its recovery
+        replay (the invariant of ServingEngineBase.submit)."""
+        if not (isinstance(contents, dict)
+                and contents.get("mx") in self._MX):
+            return False
+        mx = contents["mx"]
+        if mx in ("insRow", "insCol"):
+            key = contents.get("opKey")
+            return (self._is_nat(contents.get("pos"))
+                    and self._is_nat(contents.get("count"), 1)
+                    and contents["count"] <= self.MAX_AXIS_COUNT
+                    and isinstance(key, (list, tuple)) and len(key) == 2
+                    and all(self._is_nat(k, -(1 << 62)) for k in key)
+                    and self._is_nat(contents.get("off", 0)))
+        if mx in ("rmRow", "rmCol"):
+            return (self._is_nat(contents.get("start"))
+                    and self._is_nat(contents.get("count"), 1))
+        if mx == "setCell":
+            if not (self._is_nat(contents.get("row"))
+                    and self._is_nat(contents.get("col"))):
+                return False
+            try:
+                json.dumps(contents.get("value"))
+                return True
+            except (TypeError, ValueError):
+                return False
+        return True  # policy
+
+    def _axes_for(self, row: int) -> tuple:
+        if row not in self._axes:
+            from ..models.shared_matrix import _Axis
+            from ..core.constants import NO_CLIENT
+            self._axes[row] = (_Axis(NO_CLIENT), _Axis(NO_CLIENT))
+            self._fww[row] = False
+            self._cell_meta[row] = {}
+        return self._axes[row]
+
+    # ----------------------------------------------------------- device side
+
+    def flush(self) -> int:
+        """Walk the window in seq order: permutation ops advance the host
+        axes, setCells resolve to stable keys (and pass the FWW filter),
+        then ONE device merge applies the surviving cell writes."""
+        n = len(self._queue)
+        if not n:
+            self._after_flush(n)
+            return n
+        self._queue.sort(key=lambda dm: dm[1].seq)
+        records = []
+        for row, msg in self._queue:
+            try:
+                self._apply_one(row, msg, records)
+            except (IndexError, KeyError):
+                # an op referencing positions that do not exist at its own
+                # (ref_seq, client) perspective is a protocol violation by
+                # the submitter; dropping it keeps the server (and its
+                # recovery replay) alive — it can never become applyable
+                pass
+        self._queue.clear()
+        if records:
+            self.store.apply_batch(records)
+        self._after_flush(n)
+        return n
+
+    def _apply_one(self, row: int, msg: SequencedDocumentMessage,
+                   records: list) -> None:
+        op = msg.contents
+        mx = op["mx"]
+        rows, cols = self._axes_for(row)
+        if mx in ("insRow", "insCol"):
+            axis = rows if mx == "insRow" else cols
+            axis.insert(op["pos"], op["count"], tuple(op["opKey"]),
+                        msg.seq, msg.client_id, msg.ref_seq,
+                        local_op=None, key_offset=op.get("off", 0))
+        elif mx in ("rmRow", "rmCol"):
+            axis = rows if mx == "rmRow" else cols
+            axis.remove(op["start"], op["count"], msg.seq,
+                        msg.client_id, msg.ref_seq, local_op=None)
+        elif mx == "policy":
+            self._fww[row] = True
+        else:  # setCell
+            rk = rows.resolve(op["row"], msg.ref_seq, msg.client_id)
+            ck = cols.resolve(op["col"], msg.ref_seq, msg.client_id)
+            meta = self._cell_meta[row]
+            cell = (rk, ck)
+            if self._fww[row]:
+                seq, writer = meta.get(cell, (0, None))
+                if seq > msg.ref_seq and writer != msg.client_id:
+                    return  # FWW: unseen concurrent write loses
+            meta[cell] = (msg.seq, msg.client_id)
+            records.append(((row, rk), ck, op["value"], msg.seq))
+
+    def compact(self) -> None:
+        """Zamboni the host axes at each doc's window floor."""
+        for doc_id, row in self._doc_rows.items():
+            if row in self._axes:
+                ms = self._min_seq.get(doc_id, 0)
+                for axis in self._axes[row]:
+                    axis.tree.zamboni(ms)
+        super().compact()
+
+    # ----------------------------------------------------------------- reads
+
+    def dims(self, doc_id: str):
+        self.flush()
+        rows, cols = self._axes_for(self.doc_row(doc_id))
+        return rows.length(), cols.length()
+
+    def get_cell(self, doc_id: str, r: int, c: int):
+        self.flush()
+        row = self.doc_row(doc_id)
+        rows, cols = self._axes_for(row)
+        from ..models.merge_tree import LOCAL_VIEW
+        rk = rows.resolve(r, LOCAL_VIEW, rows.client_id)
+        ck = cols.resolve(c, LOCAL_VIEW, cols.client_id)
+        return self.store.read_cell(((row, rk), ck))
+
+    def to_lists(self, doc_id: str):
+        self.flush()
+        row = self.doc_row(doc_id)
+        rows, cols = self._axes_for(row)
+        from ..models.merge_tree import LOCAL_VIEW
+        cells = self.store.read_cells()
+        rkeys = [rows.resolve(i, LOCAL_VIEW, rows.client_id)
+                 for i in range(rows.length())]
+        ckeys = [cols.resolve(j, LOCAL_VIEW, cols.client_id)
+                 for j in range(cols.length())]
+        return [[cells.get(((row, rk), ck)) for ck in ckeys]
+                for rk in rkeys]
+
+    # ----------------------------------------------------- summary / recovery
+
+    def summarize(self) -> dict:
+        self.flush()
+        self.compact()
+        summary = self._base_summary()
+        summary["store"] = self.store.snapshot()
+        summary["axes"] = {
+            row: (rows.tree.summarize(), cols.tree.summarize())
+            for row, (rows, cols) in self._axes.items()}
+        summary["fww"] = dict(self._fww)
+        summary["cell_meta"] = {row: list(m.items())
+                                for row, m in self._cell_meta.items()}
+        summary["n_docs"] = self.n_docs
+        return summary
+
+    @classmethod
+    def load(cls, summary: dict, log: PartitionedLog,
+             **kwargs) -> "MatrixServingEngine":
+        from ..core.constants import NO_CLIENT
+        from ..models.merge_tree import MergeTree
+        from ..models.shared_matrix import _Axis
+        from ..ops.matrix_kernel import TensorMatrixStore, tuple_key
+        store = TensorMatrixStore.restore(summary["store"])
+        engine = cls(summary["n_docs"], log=log, store=store, **kwargs)
+        engine._restore_base(summary)
+        for row, (rsum, csum) in summary["axes"].items():
+            rows, cols = _Axis(NO_CLIENT), _Axis(NO_CLIENT)
+            rows.tree = MergeTree.load(rsum, local_client=NO_CLIENT)
+            cols.tree = MergeTree.load(csum, local_client=NO_CLIENT)
+            engine._axes[row] = (rows, cols)
+        engine._fww = dict(summary["fww"])
+        engine._cell_meta = {
+            row: {tuple_key(cell): tuple(sw) for cell, sw in items}
+            for row, items in summary["cell_meta"].items()}
+        for row in engine._axes:
+            engine._cell_meta.setdefault(row, {})
+            engine._fww.setdefault(row, False)
+        engine._replay_tail(summary)
+        engine.flush()
+        return engine

@@ -281,3 +281,52 @@ def test_native_log_concurrent_appends_keep_framing():
     assert len(back) == 2 * N  # nothing torn, nothing truncated
     for tag in "ab":
         assert [r["i"] for r in back if r["t"] == tag] == list(range(N))
+
+
+def test_merge_tree_summary_preserves_handles():
+    """Segment handles are payload/key identity — the matrix permutation
+    axes resolve row/col KEYS through them, so a summary that drops them
+    breaks serving-engine recovery (caught by the matrix e2e drive: a
+    recovered engine resolved every axis position to a zeroed key)."""
+    from fluidframework_tpu.core.constants import NO_CLIENT
+    from fluidframework_tpu.models.merge_tree import (
+        MergeTree, SegmentKind)
+    t = MergeTree(1)
+    t.insert(0, SegmentKind.TEXT, "abc", 1, 1, 0, handle=(42, 0))
+    t.insert(3, SegmentKind.TEXT, "def", 2, 1, 1, handle=(99, 5))
+    clone = MergeTree.load(t.summarize(), local_client=NO_CLIENT)
+    assert [s.handle for s in clone.segments] == [(42, 0), (99, 5)]
+
+
+def test_matrix_engine_nacks_malformed_structure_before_logging():
+    """Every field the matrix flush path touches must be validated before
+    the op is logged (confirmed review repro: opKey=5 raised TypeError in
+    flush forever, and recovery replayed the poison)."""
+    from fluidframework_tpu.server.deli import NackReason
+    from fluidframework_tpu.server.oplog import PartitionedLog
+    from fluidframework_tpu.server.serving import MatrixServingEngine
+    log = PartitionedLog(2)
+    engine = MatrixServingEngine(n_docs=1, cell_capacity=256, log=log)
+    engine.connect("m", 7)
+    bad_ops = [
+        {"mx": "insRow", "pos": 0, "count": 1, "opKey": 5},
+        {"mx": "insRow", "pos": "x", "count": 1, "opKey": (7, 1)},
+        {"mx": "insRow", "pos": 0, "count": 10**9, "opKey": (7, 1)},
+        {"mx": "rmRow", "start": 0, "count": 0},
+        {"mx": "setCell", "row": None, "col": 0, "value": 1},
+        {"mx": "setCell", "row": 0, "col": 0, "value": object()},
+    ]
+    for bad in bad_ops:
+        msg, nack = engine.submit("m", 7, 1, 0, bad)
+        assert msg is None and nack.reason == NackReason.MALFORMED, bad
+    # engine healthy afterwards, recovery clean
+    msg, nack = engine.submit("m", 7, 1, 0, {"mx": "insRow", "pos": 0,
+                                             "count": 2, "opKey": (7, 1)})
+    assert nack is None
+    engine.submit("m", 7, 2, msg.seq, {"mx": "insCol", "pos": 0, "count": 1,
+                                       "opKey": (7, 2)})
+    engine.submit("m", 7, 3, msg.seq, {"mx": "setCell", "row": 1, "col": 0,
+                                       "value": "ok"})
+    assert engine.get_cell("m", 1, 0) == "ok"
+    engine2 = MatrixServingEngine.load(engine.summarize(), log)
+    assert engine2.get_cell("m", 1, 0) == "ok"

@@ -364,3 +364,121 @@ class TestMapServingEngine:
         engine.connect("b", 1)
         with pytest.raises(KeyError):
             engine.read_doc("b")  # second doc exceeds n_docs=1
+
+
+# ---------------------------------------------------- matrix serving engine
+
+class TestMatrixServingEngine:
+    def _engine(self, **kw):
+        from fluidframework_tpu.server.serving import MatrixServingEngine
+        kw.setdefault("n_docs", 2)
+        kw.setdefault("cell_capacity", 4096)
+        return MatrixServingEngine(**kw)
+
+    def _oracle(self, doc):
+        from fluidframework_tpu.models import SharedMatrix
+        return SharedMatrix(doc, 999)  # pure observer replica
+
+    def _storm(self, engine, oracle, doc, rng, n_ops, fww_at=None):
+        cs = 0
+        last = {"seq": 0}
+        def submit(op):
+            nonlocal cs
+            cs += 1
+            op = dict(op, clientSeq=cs)
+            if op["mx"] in ("insRow", "insCol"):
+                op.setdefault("opKey", (7, cs))
+            msg, nack = engine.submit(doc, 7, cs, last["seq"], op)
+            assert nack is None, nack
+            last["seq"] = msg.seq
+            oracle.process_core(msg, local=False)
+        submit({"mx": "insRow", "pos": 0, "count": 4})
+        submit({"mx": "insCol", "pos": 0, "count": 4})
+        for i in range(n_ops):
+            if fww_at is not None and i == fww_at:
+                submit({"mx": "policy"})
+            nr, nc = oracle.row_count, oracle.col_count
+            roll = rng.random()
+            if roll < 0.6 and nr and nc:
+                submit({"mx": "setCell", "row": rng.randrange(nr),
+                        "col": rng.randrange(nc), "value": f"v{i}"})
+            elif roll < 0.75:
+                submit({"mx": "insRow" if roll < 0.68 else "insCol",
+                        "pos": rng.randint(0, nr if roll < 0.68 else nc),
+                        "count": rng.randint(1, 2)})
+            elif nr > 1 and roll < 0.88:
+                s = rng.randrange(nr - 1)
+                submit({"mx": "rmRow", "start": s, "count": 1})
+            elif nc > 1:
+                s = rng.randrange(nc - 1)
+                submit({"mx": "rmCol", "start": s, "count": 1})
+        return last["seq"]
+
+    def test_storm_matches_oracle(self):
+        rng = random.Random(2)
+        engine = self._engine()
+        engine.connect("m", 7)
+        oracle = self._oracle("m")
+        self._storm(engine, oracle, "m", rng, 120)
+        assert engine.to_lists("m") == oracle.to_lists()
+        assert engine.dims("m") == (oracle.row_count, oracle.col_count)
+
+    def test_fww_flip_matches_oracle(self):
+        rng = random.Random(8)
+        engine = self._engine()
+        engine.connect("m", 7)
+        oracle = self._oracle("m")
+        self._storm(engine, oracle, "m", rng, 100, fww_at=40)
+        assert engine.to_lists("m") == oracle.to_lists()
+
+    def test_fww_concurrent_writer_loses(self):
+        """A write whose ref_seq predates the current value (different
+        writer) must lose under FWW — and a later write that HAS seen it
+        must still replace (the kernel's first-ever-wins flag alone would
+        get this wrong)."""
+        engine = self._engine()
+        engine.connect("m", 1)
+        engine.connect("m", 2)
+        def submit(client, cs, ref, op):
+            msg, nack = engine.submit("m", client, cs, ref, op)
+            assert nack is None
+            return msg
+        submit(1, 1, 0, {"mx": "insRow", "pos": 0, "count": 1,
+                         "opKey": (1, 1)})
+        submit(1, 2, 0, {"mx": "insCol", "pos": 0, "count": 1,
+                         "opKey": (1, 2)})
+        submit(1, 3, 0, {"mx": "policy"})
+        m1 = submit(1, 4, 0, {"mx": "setCell", "row": 0, "col": 0,
+                              "value": "first"})
+        # client 2 wrote concurrently (ref_seq below m1.seq): loses
+        submit(2, 1, m1.seq - 1, {"mx": "setCell", "row": 0, "col": 0,
+                                  "value": "concurrent"})
+        assert engine.get_cell("m", 0, 0) == "first"
+        # client 2 writes again AFTER seeing it: replaces
+        submit(2, 2, m1.seq + 1, {"mx": "setCell", "row": 0, "col": 0,
+                                  "value": "seen"})
+        assert engine.get_cell("m", 0, 0) == "seen"
+
+    def test_summary_and_tail_recovery(self):
+        from fluidframework_tpu.server.serving import MatrixServingEngine
+        rng = random.Random(4)
+        log = PartitionedLog(4)
+        engine = self._engine(log=log)
+        engine.connect("m", 7)
+        oracle = self._oracle("m")
+        seen = self._storm(engine, oracle, "m", rng, 60)
+        summary = engine.summarize()
+        # tail ops after the summary (fresh client: the storm owns client 7)
+        engine.connect("m", 8)
+        msg, _ = engine.submit("m", 8, 1, seen,
+                               {"mx": "setCell", "row": 0, "col": 0,
+                                "value": "tail"})
+        oracle.process_core(msg, local=False)
+        engine2 = MatrixServingEngine.load(summary, log)
+        assert engine2.to_lists("m") == oracle.to_lists()
+        # engine live after recovery
+        msg, nack = engine2.submit("m", 8, 2, msg.seq,
+                                   {"mx": "setCell", "row": 0, "col": 0,
+                                    "value": "post"})
+        assert nack is None
+        assert engine2.get_cell("m", 0, 0) == "post"
