@@ -471,6 +471,7 @@ class MatrixServingEngine(ServingEngineBase):
         self._fww: Dict[int, bool] = {}
         # per-doc {cell: (seq, writer)} — the FWW visibility metadata
         self._cell_meta: Dict[int, Dict] = {}
+        self._pending_setcells = 0  # queued setCells (capacity reservation)
 
     # structural bound on one axis op (an insert allocates count slots on
     # the host axis — an unbounded count is a memory-exhaustion vector)
@@ -511,6 +512,18 @@ class MatrixServingEngine(ServingEngineBase):
                 return False
         return True  # policy
 
+    def _admit(self, doc_id: str, contents: Any) -> None:
+        super()._admit(doc_id, contents)
+        if contents["mx"] == "setCell":
+            # conservative cell-capacity reservation: distinct interned
+            # identities never shrink, and each queued setCell may mint one
+            # more — past this bound the device table would silently drop
+            # ACKED live cells at truncation, so nack before logging
+            if len(self.store._cell_ids) + self._pending_setcells \
+                    >= self.store.capacity:
+                raise KeyError("cell table capacity exhausted")
+            self._pending_setcells += 1
+
     def _axes_for(self, row: int) -> tuple:
         if row not in self._axes:
             from ..models.shared_matrix import _Axis
@@ -542,10 +555,16 @@ class MatrixServingEngine(ServingEngineBase):
                 # recovery replay) alive — it can never become applyable
                 pass
         self._queue.clear()
+        self._pending_setcells = 0
         if records:
             self.store.apply_batch(records)
         self._after_flush(n)
         return n
+
+    def overflowed(self) -> bool:
+        """Sticky device-table overflow flag (should stay False: admission
+        reserves capacity; True means re-bucket with a larger table)."""
+        return self.store.overflowed()
 
     def _apply_one(self, row: int, msg: SequencedDocumentMessage,
                    records: list) -> None:

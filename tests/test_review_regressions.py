@@ -330,3 +330,43 @@ def test_matrix_engine_nacks_malformed_structure_before_logging():
     assert engine.get_cell("m", 1, 0) == "ok"
     engine2 = MatrixServingEngine.load(engine.summarize(), log)
     assert engine2.get_cell("m", 1, 0) == "ok"
+
+
+def test_matrix_engine_nacks_cell_capacity_before_logging():
+    """An acked setCell must never be silently dropped by device-table
+    truncation (confirmed review repro: 16 acked writes, 8 read back None).
+    Admission reserves cell capacity and nacks CAPACITY past the bound."""
+    from fluidframework_tpu.server.deli import NackReason
+    from fluidframework_tpu.server.oplog import PartitionedLog
+    from fluidframework_tpu.server.serving import MatrixServingEngine
+    log = PartitionedLog(2)
+    engine = MatrixServingEngine(n_docs=1, cell_capacity=8, log=log,
+                                 batch_window=64)
+    engine.connect("m", 7)
+    seen = 0
+    def submit(cs, op):
+        nonlocal seen
+        msg, nack = engine.submit("m", 7, cs, seen, op)
+        if msg is not None:
+            seen = msg.seq
+        return msg, nack
+    submit(1, {"mx": "insRow", "pos": 0, "count": 16, "opKey": (7, 1)})
+    submit(2, {"mx": "insCol", "pos": 0, "count": 1, "opKey": (7, 2)})
+    acked, nacked = [], 0
+    for i in range(16):
+        msg, nack = submit(3 + i, {"mx": "setCell", "row": i, "col": 0,
+                                   "value": f"v{i}"})
+        if nack is None:
+            acked.append(i)
+        else:
+            assert nack.reason == NackReason.CAPACITY
+            nacked += 1
+    assert nacked > 0
+    # EVERY acked write is readable — no silent loss
+    for i in acked:
+        assert engine.get_cell("m", i, 0) == f"v{i}", i
+    assert not engine.overflowed()
+    # and recovery preserves them all
+    engine2 = MatrixServingEngine.load(engine.summarize(), log)
+    for i in acked:
+        assert engine2.get_cell("m", i, 0) == f"v{i}", i

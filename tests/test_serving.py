@@ -482,3 +482,85 @@ class TestMatrixServingEngine:
                                     "value": "post"})
         assert nack is None
         assert engine2.get_cell("m", 0, 0) == "post"
+
+
+# ------------------------------------------- serving service (full stack)
+
+class TestServingLocalService:
+    """Interactive clients on the FULL container stack (loader + runtime +
+    outbox grouping/compression) against a service whose sequenced stream
+    also feeds the device replica — server-side reads with no client."""
+
+    def _mk(self, **kw):
+        from fluidframework_tpu.framework import LocalClient
+        from fluidframework_tpu.server.serving_service import (
+            ServingLocalService)
+        svc = ServingLocalService(n_docs=8, capacity=512, **kw)
+        return svc, LocalClient(service=svc)
+
+    def test_container_edits_served_on_device(self):
+        svc, client = self._mk()
+        schema = {"initialObjects": {"text": "sharedString"}}
+        c1, doc_id = client.create_container(schema)
+        c2 = client.get_container(doc_id, schema)
+        t1 = c1.initial_objects["text"]
+        t2 = c2.initial_objects["text"]
+        t1.insert_text(0, "hello world", {"bold": True})
+        t2.insert_text(0, "[b] ")
+        t1.annotate_range(0, 2, {"color": "red"})
+        t1.remove_text(0, 1)
+        # the container stack delivers synchronously through LocalService;
+        # all replicas and the SERVER's device replica must agree
+        assert t1.get_text() == t2.get_text()
+        assert svc.read_text(doc_id, "text") == t1.get_text()
+        for pos in range(t1.get_length()):
+            assert svc.get_properties(doc_id, "text", pos) == \
+                t1.get_properties(pos), pos
+
+    def test_multiple_docs_and_channels(self):
+        svc, client = self._mk()
+        schema = {"initialObjects": {"a": "sharedString",
+                                     "b": "sharedString"}}
+        c1, d1 = client.create_container(schema)
+        c2, d2 = client.create_container(schema)
+        c1.initial_objects["a"].insert_text(0, "doc1-a")
+        c1.initial_objects["b"].insert_text(0, "doc1-b")
+        c2.initial_objects["a"].insert_text(0, "doc2-a")
+        assert svc.read_text(d1, "a") == "doc1-a"
+        assert svc.read_text(d1, "b") == "doc1-b"
+        assert svc.read_text(d2, "a") == "doc2-a"
+        assert set(svc.served_channels(d1)) == {("default", "a"),
+                                                ("default", "b")}
+
+    def test_storm_with_compaction_matches_clients(self):
+        import random as _r
+        rng = _r.Random(13)
+        svc, client = self._mk(batch_window=8, compact_every=2)
+        schema = {"initialObjects": {"text": "sharedString"}}
+        c1, doc_id = client.create_container(schema)
+        c2 = client.get_container(doc_id, schema)
+        texts = [c1.initial_objects["text"], c2.initial_objects["text"]]
+        for i in range(120):
+            t = rng.choice(texts)
+            n = t.get_length()
+            roll = rng.random()
+            if n == 0 or roll < 0.6:
+                t.insert_text(rng.randint(0, n), f"w{i} ")
+            elif roll < 0.8:
+                s = rng.randrange(n)
+                t.remove_text(s, rng.randint(s + 1, min(n, s + 5)))
+            else:
+                s = rng.randrange(n)
+                t.annotate_range(s, rng.randint(s + 1, min(n, s + 4)),
+                                 {"k": rng.randint(0, 3)})
+        assert texts[0].get_text() == texts[1].get_text()
+        assert svc.read_text(doc_id, "text") == texts[0].get_text()
+
+    def test_non_string_channels_ignored(self):
+        svc, client = self._mk()
+        schema = {"initialObjects": {"m": "map", "text": "sharedString"}}
+        c1, doc_id = client.create_container(schema)
+        c1.initial_objects["m"].set("k", 1)
+        c1.initial_objects["text"].insert_text(0, "served")
+        assert svc.read_text(doc_id, "text") == "served"
+        assert svc.served_channels(doc_id) == [("default", "text")]
