@@ -78,6 +78,9 @@ class SequencedDocumentMessage:
     # channel routing address (reference: the /dataStoreId/channelId envelope
     # the container runtime routes by — SURVEY.md §3.2). None = document-level.
     address: Optional[str] = None
+    # service-stamped wall time (reference: ISequencedDocumentMessage
+    # .timestamp, stamped by Deli) — the "when" of attribution
+    timestamp: Optional[float] = None
 
     def is_from(self, client_id: int) -> bool:
         return self.client_id == client_id

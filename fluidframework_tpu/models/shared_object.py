@@ -34,6 +34,7 @@ class SharedObject:
         self._submit_fn: Optional[Callable[[dict], None]] = None
         self._attached = False
         self._listeners: Dict[str, list] = {}
+        self._attributor = None  # opt-in (see attach_attributor)
 
     # ---------------------------------------------------------------- events
     # Reference: DDSes are EventEmitters (SharedMap "valueChanged"/"clear",
@@ -68,9 +69,16 @@ class SharedObject:
 
     # -------------------------------------------------------------- op inbox
 
+    def attach_attributor(self, attributor) -> None:
+        """Record every sequenced op's (client, timestamp) by seq
+        (reference: @fluid-experimental/attributor's op-stream wiring)."""
+        self._attributor = attributor
+
     def apply_msg(self, msg: SequencedDocumentMessage) -> None:
         """Process one sequenced op (reference: SharedObject.process)."""
         assert msg.seq > self.last_processed_seq, "ops must arrive in seq order"
+        if self._attributor is not None:
+            self._attributor.record(msg)
         addressed_here = msg.address is None or msg.address == self.id
         if msg.type == MessageType.OP and msg.contents is not None \
                 and addressed_here:

@@ -258,24 +258,37 @@ class TensorStringStore(StringOpInterner):
         length = np.asarray(st.length[doc][:n])
         return int(length[rem == NOT_REMOVED].sum())
 
-    def get_properties(self, doc: int, pos: int) -> dict:
-        """Properties of the character at visible position pos (reference:
-        ``SharedString.getPropertiesAtPosition``)."""
+    def _slot_at(self, doc: int, pos: int) -> int:
+        """Slot index holding visible position ``pos`` (skip tombstones,
+        accumulate live lengths)."""
         st = self.state
         n = int(st.count[doc])
         rem = np.asarray(st.removed_seq[doc][:n])
         length = np.asarray(st.length[doc][:n])
-        pv = np.asarray(st.prop_val[doc][:n])
         at = 0
         for i in range(n):
             if rem[i] != NOT_REMOVED:
                 continue
             if at <= pos < at + length[i]:
-                return {key: self._prop_values.value(int(pv[i, plane]))
-                        for key, plane in self._prop_planes.items()
-                        if pv[i, plane] != 0}
+                return i
             at += length[i]
         raise IndexError(f"doc {doc}: position {pos} beyond length {at}")
+
+    def seq_at(self, doc: int, pos: int) -> int:
+        """Insert seq of the slot holding visible position ``pos`` — the
+        attribution key (reference: merge-tree segments carry their seq;
+        the device seq plane stores the same)."""
+        return int(np.asarray(
+            self.state.seq[doc][self._slot_at(doc, pos)]))
+
+    def get_properties(self, doc: int, pos: int) -> dict:
+        """Properties of the character at visible position pos (reference:
+        ``SharedString.getPropertiesAtPosition``)."""
+        i = self._slot_at(doc, pos)
+        pv = np.asarray(self.state.prop_val[doc][i])
+        return {key: self._prop_values.value(int(pv[plane]))
+                for key, plane in self._prop_planes.items()
+                if pv[plane] != 0}
 
     # -------------------------------------------------------- intervals
     # Anchored ranges over the served text (reference: IntervalCollection /

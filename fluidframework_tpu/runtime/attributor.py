@@ -1,0 +1,87 @@
+"""Attribution: who wrote what, and when.
+
+Reference counterpart: ``@fluid-experimental/attributor``
+(``OpStreamAttributor``, attribution keys = op sequence numbers, the
+attributor serialized alongside summaries; merge-tree segments already
+carry their insert seq, which IS the attribution key). Here the op stream
+is the source of truth: the attributor records each sequenced op's
+(client, service timestamp) by seq, and position-level queries go
+segment-seq → attributor — both on the interactive client (oracle merge
+tree) and on the serving engine (the device seq plane), since the device
+stores the same seq per slot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from ..core.constants import SEQ_UNASSIGNED
+from ..core.protocol import MessageType, SequencedDocumentMessage
+
+LOCAL_ATTRIBUTION = "local"  # pending local edit: not yet sequenced
+
+
+@dataclasses.dataclass(frozen=True)
+class AttributionInfo:
+    client_id: int
+    timestamp: Optional[float]
+
+
+class Attributor:
+    """seq → (client, timestamp) for every sequenced OP message."""
+
+    def __init__(self):
+        self._entries: Dict[int, AttributionInfo] = {}
+
+    def record(self, msg: SequencedDocumentMessage) -> None:
+        if msg.type == MessageType.OP and msg.client_id >= 0:
+            self._entries[msg.seq] = AttributionInfo(
+                msg.client_id, msg.timestamp)
+
+    def get(self, seq: int) -> AttributionInfo:
+        try:
+            return self._entries[seq]
+        except KeyError:
+            raise KeyError(
+                f"seq {seq} has no attribution entry — it was sequenced "
+                f"before this attributor started recording (attach the "
+                f"attributor before the ops you want attributed)") from None
+
+    def has(self, seq: int) -> bool:
+        return seq in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # --------------------------------------------------- summary / resume
+
+    def summarize(self) -> dict:
+        """Compact column encoding (seqs ascending), the reference's
+        summary-serialized attributor."""
+        seqs = sorted(self._entries)
+        return {
+            "seqs": seqs,
+            "clients": [self._entries[s].client_id for s in seqs],
+            "timestamps": [self._entries[s].timestamp for s in seqs],
+        }
+
+    @classmethod
+    def load(cls, summary: dict) -> "Attributor":
+        att = cls()
+        for s, c, t in zip(summary["seqs"], summary["clients"],
+                           summary["timestamps"]):
+            att._entries[s] = AttributionInfo(c, t)
+        return att
+
+
+def string_attribution_at(shared_string, attributor: Attributor, pos: int):
+    """Attribution of the character at ``pos`` of a SharedString replica:
+    the containing segment's insert seq resolved through the attributor.
+    A pending local insert attributes to ``LOCAL_ATTRIBUTION``."""
+    seg, _ = shared_string.tree.get_containing_segment(pos)
+    if seg is None:
+        raise IndexError(f"position {pos} beyond document")
+    if seg.seq == SEQ_UNASSIGNED:
+        return LOCAL_ATTRIBUTION
+    return attributor.get(seg.seq)

@@ -59,8 +59,12 @@ class _DocState:
 class DeliSequencer:
     """Sequencer for the documents of one partition."""
 
-    def __init__(self):
+    def __init__(self, clock=None):
         self._docs: Dict[str, _DocState] = {}
+        # service wall clock for message timestamps (reference: Deli stamps
+        # ISequencedDocumentMessage.timestamp); injectable for determinism
+        import time as _time
+        self.clock = clock if clock is not None else _time.time
 
     def _doc(self, doc_id: str) -> _DocState:
         if doc_id not in self._docs:
@@ -132,7 +136,7 @@ class DeliSequencer:
         msg = SequencedDocumentMessage(
             doc_id=doc_id, client_id=client_id, client_seq=client_seq,
             ref_seq=ref_seq, seq=doc.seq, min_seq=doc.min_seq, type=type,
-            contents=contents, address=address)
+            contents=contents, address=address, timestamp=self.clock())
         return msg, None
 
     # ---------------------------------------------------------- checkpoints
@@ -153,8 +157,8 @@ class DeliSequencer:
         }
 
     @classmethod
-    def restore(cls, snapshot: dict) -> "DeliSequencer":
-        deli = cls()
+    def restore(cls, snapshot: dict, clock=None) -> "DeliSequencer":
+        deli = cls(clock)
         for doc_id, d in snapshot.items():
             doc = _DocState(seq=d["seq"], min_seq=d["minSeq"])
             for cid, (lcs, rs) in d["clients"].items():

@@ -62,11 +62,14 @@ def available() -> bool:
 
 # ------------------------------------------------------------------- codec
 # Fixed header (little-endian): client_id, client_seq, ref_seq, seq,
-# min_seq as int64, type as int32, doc_id length as int32 — then doc_id
-# bytes, then the JSON-encoded contents blob. The ints the device kernels
-# consume ride in fixed slots; only the variable payload needs JSON.
+# min_seq as int64, type as int32, doc_id length as int32, service
+# timestamp as float64 (NaN = unset) — then doc_id bytes, then the
+# JSON-encoded contents blob. The ints the device kernels consume ride in
+# fixed slots; only the variable payload needs JSON.
 
-_HEADER = struct.Struct("<qqqqqii")
+_HEADER = struct.Struct("<qqqqqiid")
+_HEADER_V1 = struct.Struct("<qqqqqii")  # pre-timestamp logs (tag b"M")
+_NO_TS = float("nan")
 
 
 def encode_message(msg: SequencedDocumentMessage) -> bytes:
@@ -74,21 +77,30 @@ def encode_message(msg: SequencedDocumentMessage) -> bytes:
     contents = json.dumps(
         {"c": msg.contents, "a": msg.address, "m": msg.metadata},
         default=str).encode()
+    ts = _NO_TS if msg.timestamp is None else float(msg.timestamp)
     return _HEADER.pack(msg.client_id, msg.client_seq, msg.ref_seq,
                         msg.seq, msg.min_seq, int(msg.type),
-                        len(doc)) + doc + contents
+                        len(doc), ts) + doc + contents
 
 
-def decode_message(data: bytes) -> SequencedDocumentMessage:
-    (client_id, client_seq, ref_seq, seq, min_seq, mtype,
-     doc_len) = _HEADER.unpack_from(data)
-    doc_id = data[_HEADER.size:_HEADER.size + doc_len].decode()
-    blob = json.loads(data[_HEADER.size + doc_len:])
+def decode_message(data: bytes,
+                   header: struct.Struct = _HEADER
+                   ) -> SequencedDocumentMessage:
+    if header is _HEADER_V1:
+        (client_id, client_seq, ref_seq, seq, min_seq, mtype,
+         doc_len) = header.unpack_from(data)
+        ts = _NO_TS
+    else:
+        (client_id, client_seq, ref_seq, seq, min_seq, mtype,
+         doc_len, ts) = header.unpack_from(data)
+    doc_id = data[header.size:header.size + doc_len].decode()
+    blob = json.loads(data[header.size + doc_len:])
     msg = SequencedDocumentMessage(
         doc_id=doc_id, client_id=client_id, client_seq=client_seq,
         ref_seq=ref_seq, seq=seq, min_seq=min_seq,
         type=MessageType(mtype), contents=blob["c"],
-        metadata=blob.get("m"), address=blob.get("a"))
+        metadata=blob.get("m"), address=blob.get("a"),
+        timestamp=None if ts != ts else ts)
     return msg
 
 
@@ -122,10 +134,12 @@ class NativePartitionedLog:
         self._plocks = [threading.RLock() for _ in range(n_partitions)]
 
     def append(self, partition: int, record: Any) -> int:
+        # tags: b"N" = message with the current header (has timestamp),
+        # b"M" = pre-timestamp header (old logs, read-only), b"J" = JSON
         data = encode_message(record) \
             if isinstance(record, SequencedDocumentMessage) \
             else json.dumps(record, default=str).encode()
-        tag = b"M" if isinstance(record, SequencedDocumentMessage) else b"J"
+        tag = b"N" if isinstance(record, SequencedDocumentMessage) else b"J"
         with self._plocks[partition]:
             offset = self._lib.oplog_append(self._h, partition, tag + data,
                                             len(data) + 1)
@@ -156,8 +170,11 @@ class NativePartitionedLog:
             if got != n:
                 raise IOError(f"read p{partition}@{offset} failed (CRC?)")
         raw = bytes(buf)
-        return decode_message(raw[1:]) if raw[:1] == b"M" \
-            else json.loads(raw[1:])
+        if raw[:1] == b"N":
+            return decode_message(raw[1:])
+        if raw[:1] == b"M":  # pre-timestamp record from an older log
+            return decode_message(raw[1:], header=_HEADER_V1)
+        return json.loads(raw[1:])
 
     def read(self, partition: int, from_offset: int = 0):
         for off in range(from_offset, self.size(partition)):

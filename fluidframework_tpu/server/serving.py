@@ -52,6 +52,25 @@ class ServingEngineBase:
         self._queue: List[Tuple[int, SequencedDocumentMessage]] = []
         self._flushes_since_compact = 0
         self._min_seq: Dict[str, int] = {}
+        # opt-in (enable_attribution): ONE attributor per document —
+        # Deli seqs are per-doc, so a shared table would collide across docs
+        self._attributors: Optional[Dict[str, Any]] = None
+
+    def enable_attribution(self) -> None:
+        """Record (client, timestamp) per sequenced op for serving-side
+        attribution queries (reference: @fluid-experimental/attributor)."""
+        if self._attributors is None:
+            self._attributors = {}
+
+    def _attributor_of(self, doc_id: str):
+        from ..runtime.attributor import Attributor
+        if doc_id not in self._attributors:
+            self._attributors[doc_id] = Attributor()
+        return self._attributors[doc_id]
+
+    def _record_attribution(self, msg: SequencedDocumentMessage) -> None:
+        if self._attributors is not None:
+            self._attributor_of(msg.doc_id).record(msg)
 
     # ------------------------------------------------------------ membership
 
@@ -100,6 +119,7 @@ class ServingEngineBase:
         if nack is not None:
             return None, nack
         self._log_append(doc_id, msg)
+        self._record_attribution(msg)
         self._enqueue(doc_id, msg)
         self._min_seq[doc_id] = msg.min_seq
         if self._queued() >= self.batch_window:
@@ -141,18 +161,28 @@ class ServingEngineBase:
     # calls _restore_base() then _replay_tail().
 
     def _base_summary(self) -> dict:
-        return {
+        out = {
             "deli": self.deli.checkpoint(),
             "log_offsets": [self.log.size(p)
                             for p in range(self.log.n_partitions)],
             "doc_rows": dict(self._doc_rows),
             "min_seq": dict(self._min_seq),
         }
+        if self._attributors is not None:
+            out["attribution"] = {d: a.summarize()
+                                  for d, a in self._attributors.items()}
+        return out
 
     def _restore_base(self, summary: dict) -> None:
-        self.deli = DeliSequencer.restore(summary["deli"])
+        # keep the engine's (possibly injected deterministic) clock
+        self.deli = DeliSequencer.restore(summary["deli"],
+                                          clock=self.deli.clock)
         self._doc_rows = dict(summary["doc_rows"])
         self._min_seq = dict(summary["min_seq"])
+        if summary.get("attribution") is not None:
+            from ..runtime.attributor import Attributor
+            self._attributors = {d: Attributor.load(a)
+                                 for d, a in summary["attribution"].items()}
 
     def _replay_tail(self, summary: dict, control_hook=None) -> None:
         """Replay EVERY tail message through the sequencer state (so
@@ -164,6 +194,7 @@ class ServingEngineBase:
         for p in range(self.log.n_partitions):
             for msg in self.log.read(p, from_offset=summary["log_offsets"][p]):
                 self.deli.replay(msg)
+                self._record_attribution(msg)
                 if control_hook is not None and control_hook(msg):
                     continue
                 if msg.type == MessageType.OP:
@@ -299,6 +330,15 @@ class StringServingEngine(ServingEngineBase):
         self.flush()
         store, row = self._store_of(doc_id)
         return store.get_properties(row, pos)
+
+    def attribution_at(self, doc_id: str, pos: int):
+        """Who wrote the character at ``pos`` (and when): the device seq
+        plane resolves to the engine attributor (enable_attribution)."""
+        if self._attributors is None:
+            raise RuntimeError("call enable_attribution() first")
+        self.flush()
+        store, row = self._store_of(doc_id)
+        return self._attributor_of(doc_id).get(store.seq_at(row, pos))
 
     def overflowed_docs(self) -> List[str]:
         """Docs whose device capacity overflowed (ops dropped): these must

@@ -92,6 +92,8 @@ class MockSequencer:
             type=raw["type"],
             contents=raw["contents"],
             address=raw.get("address"),
+            # deterministic service timestamp: one tick per sequenced op
+            timestamp=float(self.seq),
         )
         for replica in list(self._replicas):
             replica.apply_msg(msg)

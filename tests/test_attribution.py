@@ -1,0 +1,186 @@
+"""Attribution subsystem (reference: @fluid-experimental/attributor).
+
+Op-stream attribution keyed by sequence number: who typed each character,
+with the service-stamped timestamp — on interactive replicas (merge-tree
+segment seq → attributor) and on the serving engine (device seq plane →
+attributor), surviving splits, summaries, and recovery.
+"""
+
+import pytest
+
+from fluidframework_tpu.models import SharedString
+from fluidframework_tpu.models.merge_tree_client import SequenceClient
+from fluidframework_tpu.runtime.attributor import (
+    LOCAL_ATTRIBUTION,
+    Attributor,
+    string_attribution_at,
+)
+from fluidframework_tpu.server.oplog import PartitionedLog
+from fluidframework_tpu.server.serving import StringServingEngine
+from fluidframework_tpu.testing.mocks import MockSequencer, create_connected_dds
+
+
+def test_client_side_attribution_per_character():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedString, "s")
+    b = create_connected_dds(seqr, SharedString, "s")
+    att = Attributor()
+    b.attach_attributor(att)
+    a.insert_text(0, "aaa")
+    b.insert_text(0, "bb")
+    seqr.process_all_messages()
+    # a remote insert SPLITS a's run on b? (b's text lands at 0) — either
+    # way every char attributes to its writer
+    text = b.get_text()
+    for pos, ch in enumerate(text):
+        info = string_attribution_at(b, att, pos)
+        want = a.client_id if ch == "a" else b.client_id
+        assert info.client_id == want, (pos, ch)
+        assert info.timestamp is not None
+
+
+def test_pending_local_edit_attributes_local():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedString, "s")
+    att = Attributor()
+    a.attach_attributor(att)
+    a.insert_text(0, "x")  # not yet sequenced
+    assert string_attribution_at(a, att, 0) == LOCAL_ATTRIBUTION
+    seqr.process_all_messages()
+    assert string_attribution_at(a, att, 0).client_id == a.client_id
+
+
+def test_attribution_survives_split_and_zamboni():
+    from fluidframework_tpu.core.protocol import MessageType
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedString, "s")
+    b = create_connected_dds(seqr, SharedString, "s")
+    att = Attributor()
+    a.attach_attributor(att)
+    a.insert_text(0, "hello world")
+    seqr.process_all_messages()
+    b.insert_text(5, "|B|")  # splits a's segment
+    seqr.process_all_messages()
+    for _ in range(3):
+        for r in (a, b):
+            seqr.submit(r, {}, type=MessageType.NOOP)
+        seqr.process_all_messages()  # zamboni
+    text = a.get_text()
+    for pos, ch in enumerate(text):
+        want = b.client_id if ch in "|B" else a.client_id
+        assert string_attribution_at(a, att, pos).client_id == want, (pos, ch)
+
+
+def test_attributor_summary_roundtrip():
+    seqr = MockSequencer()
+    a = create_connected_dds(seqr, SharedString, "s")
+    att = Attributor()
+    a.attach_attributor(att)
+    a.insert_text(0, "abc")
+    a.annotate_range(0, 2, {"b": 1})
+    seqr.process_all_messages()
+    clone = Attributor.load(att.summarize())
+    assert len(clone) == len(att) == 2
+    for seq in (1, 2):
+        assert clone.get(seq) == att.get(seq)
+
+
+def test_serving_engine_attribution_and_recovery():
+    log = PartitionedLog(4)
+    engine = StringServingEngine(n_docs=1, capacity=128, log=log)
+    engine.enable_attribution()
+    engine.connect("d", 1)
+    engine.connect("d", 2)
+    c1, c2 = SequenceClient(1), SequenceClient(2)
+    clients = [c1, c2]
+
+    def submit(c, op):
+        msg, nack = engine.submit("d", c.client_id, op["clientSeq"],
+                                  c.last_processed_seq, op)
+        assert nack is None
+        for cc in clients:
+            cc.apply_msg(msg)
+    submit(c1, c1.insert_text_local(0, "one "))
+    submit(c2, c2.insert_text_local(4, "two "))
+    summary = engine.summarize()
+    submit(c1, c1.insert_text_local(8, "tail"))  # after the summary
+
+    for eng in (engine, StringServingEngine.load(summary, log)):
+        text = eng.read_text("d")
+        assert text == c1.get_text()
+        assert eng.attribution_at("d", 0).client_id == 1
+        assert eng.attribution_at("d", 4).client_id == 2
+        assert eng.attribution_at("d", 8).client_id == 1
+        assert eng.attribution_at("d", 0).timestamp is not None
+        with pytest.raises(IndexError):
+            eng.attribution_at("d", 99)
+
+
+def test_native_codec_preserves_timestamp():
+    from fluidframework_tpu.server.native_oplog import (
+        available, decode_message, encode_message)
+    if not available():
+        pytest.skip("native oplog not built")
+    from fluidframework_tpu.core.protocol import (
+        MessageType, SequencedDocumentMessage)
+    for ts in (None, 0.0, 1234.5):
+        m = SequencedDocumentMessage(
+            doc_id="d", client_id=1, client_seq=1, ref_seq=0, seq=1,
+            min_seq=0, type=MessageType.OP, contents={"x": 1}, timestamp=ts)
+        assert decode_message(encode_message(m)) == m
+
+
+def test_engine_attribution_keyed_per_document():
+    """Deli seqs are per-doc: ops from two docs sharing seq numbers must
+    not collide in the engine attributor (review finding)."""
+    engine = StringServingEngine(n_docs=2, capacity=64)
+    engine.enable_attribution()
+    engine.connect("a", 1)
+    engine.connect("b", 2)
+    ca, cb = SequenceClient(1), SequenceClient(2)
+    op = ca.insert_text_local(0, "A")
+    msg, _ = engine.submit("a", 1, op["clientSeq"], 0, op)
+    ca.apply_msg(msg)
+    op = cb.insert_text_local(0, "B")
+    msg, _ = engine.submit("b", 2, op["clientSeq"], 0, op)  # same seq as a's
+    cb.apply_msg(msg)
+    assert engine.attribution_at("a", 0).client_id == 1
+    assert engine.attribution_at("b", 0).client_id == 2
+
+
+def test_native_codec_reads_pre_timestamp_records(tmp_path):
+    """Logs written before the timestamp field (tag M, 48-byte header)
+    must still decode after the upgrade (review finding: silent corruption
+    of durable logs on format change)."""
+    from fluidframework_tpu.server import native_oplog as no
+    if not no.available():
+        pytest.skip("native oplog not built")
+    import json as _json
+    from fluidframework_tpu.core.protocol import (MessageType,
+                                                  SequencedDocumentMessage)
+    m = SequencedDocumentMessage(
+        doc_id="doc", client_id=3, client_seq=4, ref_seq=2, seq=5,
+        min_seq=1, type=MessageType.OP, contents={"mt": "remove"},
+        address="ds")
+    # hand-craft an OLD record: V1 header, no timestamp, tag b"M"
+    doc = m.doc_id.encode()
+    blob = _json.dumps({"c": m.contents, "a": m.address,
+                        "m": m.metadata}).encode()
+    old = no._HEADER_V1.pack(m.client_id, m.client_seq, m.ref_seq, m.seq,
+                             m.min_seq, int(m.type), len(doc)) + doc + blob
+    log = no.NativePartitionedLog(str(tmp_path), 1)
+    log._lib.oplog_append(log._h, 0, b"M" + old, len(old) + 1)
+    back = list(log.read(0))[0]
+    assert back.doc_id == "doc" and back.seq == 5
+    assert back.contents == {"mt": "remove"} and back.address == "ds"
+    assert back.timestamp is None
+
+
+def test_deli_restore_keeps_injected_clock():
+    from fluidframework_tpu.core.protocol import MessageType
+    from fluidframework_tpu.server.deli import DeliSequencer
+    d = DeliSequencer(clock=lambda: 42.0)
+    d.client_join("x", 1)
+    d2 = DeliSequencer.restore(d.checkpoint(), clock=d.clock)
+    msg, _ = d2.sequence("x", 1, 1, 0, MessageType.OP, {})
+    assert msg.timestamp == 42.0
