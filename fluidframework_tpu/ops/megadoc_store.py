@@ -20,6 +20,7 @@ from .megadoc_kernel import (
     apply_megadoc_batch, compact_megadoc, create_megadoc_state,
     make_megadoc_mesh, megadoc_digest, rebalance_megadoc, visible_runs,
 )
+from ..core.constants import NOT_REMOVED
 from .schema import OpKind
 from .string_store import _TEXT, StringOpInterner
 
@@ -117,6 +118,28 @@ class MegaDocStringStore(StringOpInterner):
 
     def visible_length(self, doc: int) -> int:
         return sum(ln for _op, _off, ln, _p in self._runs()[doc])
+
+    def seq_at(self, doc: int, pos: int) -> int:
+        """Insert seq of the slot holding visible position ``pos`` — the
+        attribution key, walked shard-major over the sharded planes (same
+        contract as TensorStringStore.seq_at)."""
+        st = self.state
+        count = np.asarray(st.count)
+        rem = np.asarray(st.removed_seq)
+        ln = np.asarray(st.length)
+        sq = np.asarray(st.seq)
+        n_shards = count.shape[1]
+        s_local = ln.shape[1] // n_shards
+        at = 0
+        for s in range(n_shards):
+            lo = s * s_local
+            for i in range(lo, lo + count[doc, s]):
+                if rem[doc, i] != NOT_REMOVED:
+                    continue
+                if at <= pos < at + ln[doc, i]:
+                    return int(sq[doc, i])
+                at += ln[doc, i]
+        raise IndexError(f"doc {doc}: position {pos} beyond length {at}")
 
     def get_properties(self, doc: int, pos: int) -> dict:
         """Properties of the character at visible position pos."""

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
@@ -63,8 +64,7 @@ class DeliSequencer:
         self._docs: Dict[str, _DocState] = {}
         # service wall clock for message timestamps (reference: Deli stamps
         # ISequencedDocumentMessage.timestamp); injectable for determinism
-        import time as _time
-        self.clock = clock if clock is not None else _time.time
+        self.clock = clock if clock is not None else time.time
 
     def _doc(self, doc_id: str) -> _DocState:
         if doc_id not in self._docs:

@@ -23,11 +23,14 @@ latency exactly like the reference's outbox flush policy.
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..runtime.attributor import Attributor
+from ..utils.telemetry import MetricsCollector
 from ..ops.map_kernel import TensorMapStore
 from ..ops.schema import OpKind
 from ..ops.string_store import TensorStringStore
@@ -55,6 +58,9 @@ class ServingEngineBase:
         # opt-in (enable_attribution): ONE attributor per document —
         # Deli seqs are per-doc, so a shared table would collide across docs
         self._attributors: Optional[Dict[str, Any]] = None
+        # per-lambda observability (SURVEY.md §5.5: op rate, nacks by
+        # reason, flush batch sizes, flush latency percentiles)
+        self.metrics = MetricsCollector()
 
     def enable_attribution(self) -> None:
         """Record (client, timestamp) per sequenced op for serving-side
@@ -63,7 +69,6 @@ class ServingEngineBase:
             self._attributors = {}
 
     def _attributor_of(self, doc_id: str):
-        from ..runtime.attributor import Attributor
         if doc_id not in self._attributors:
             self._attributors[doc_id] = Attributor()
         return self._attributors[doc_id]
@@ -107,17 +112,18 @@ class ServingEngineBase:
         op the flush path cannot apply would poison the engine AND its
         recovery replay (the log is replayed through the same path)."""
         if not self._valid_op(contents):
-            return None, Nack(doc_id, client_id, client_seq,
-                              NackReason.MALFORMED)
+            return self._nacked(Nack(doc_id, client_id, client_seq,
+                                     NackReason.MALFORMED))
         try:
             self._admit(doc_id, contents)
         except KeyError:
-            return None, Nack(doc_id, client_id, client_seq,
-                              NackReason.CAPACITY)
+            return self._nacked(Nack(doc_id, client_id, client_seq,
+                                     NackReason.CAPACITY))
         msg, nack = self.deli.sequence(
             doc_id, client_id, client_seq, ref_seq, MessageType.OP, contents)
         if nack is not None:
-            return None, nack
+            return self._nacked(nack)
+        self.metrics.inc("ops_ingested")
         self._log_append(doc_id, msg)
         self._record_attribution(msg)
         self._enqueue(doc_id, msg)
@@ -125,6 +131,11 @@ class ServingEngineBase:
         if self._queued() >= self.batch_window:
             self.flush()
         return msg, None
+
+    def _nacked(self, nack: Nack) -> Tuple[None, Nack]:
+        self.metrics.inc("nacks")
+        self.metrics.inc(f"nacks_{nack.reason.name.lower()}")
+        return None, nack
 
     def _valid_op(self, contents: Any) -> bool:
         """Subclasses reject op shapes their flush path cannot apply."""
@@ -145,6 +156,23 @@ class ServingEngineBase:
     def _queued(self) -> int:
         return len(self._queue)
 
+    def flush(self) -> int:
+        """Template: time the subclass's device apply, record batch-size
+        and latency metrics, drive the compaction cadence."""
+        t0 = time.perf_counter()
+        n = self._flush_impl()
+        if n:
+            self.metrics.inc("flushes")
+            self.metrics.inc("ops_flushed", n)
+            self.metrics.observe("flush_ms",
+                                 (time.perf_counter() - t0) * 1000)
+        self._after_flush(n)
+        return n
+
+    def _flush_impl(self) -> int:
+        """Apply the queued window on device; returns messages applied."""
+        raise NotImplementedError
+
     def _after_flush(self, n: int) -> None:
         if n:
             self._flushes_since_compact += 1
@@ -152,6 +180,7 @@ class ServingEngineBase:
                 self.compact()
 
     def compact(self) -> None:
+        self.metrics.inc("compactions")
         self._flushes_since_compact = 0
 
     # ----------------------------------------------------- summary / recovery
@@ -180,7 +209,6 @@ class ServingEngineBase:
         self._doc_rows = dict(summary["doc_rows"])
         self._min_seq = dict(summary["min_seq"])
         if summary.get("attribution") is not None:
-            from ..runtime.attributor import Attributor
             self._attributors = {d: Attributor.load(a)
                                  for d, a in summary["attribution"].items()}
 
@@ -289,7 +317,7 @@ class StringServingEngine(ServingEngineBase):
 
     # ----------------------------------------------------------- device side
 
-    def flush(self) -> int:
+    def _flush_impl(self) -> int:
         """Merge the queued window on device in one batched apply per tier."""
         n = self._queued()
         if self._queue:
@@ -298,7 +326,6 @@ class StringServingEngine(ServingEngineBase):
         if self._mega_queue:
             self.mega_store.apply_messages(self._mega_queue)
             self._mega_queue.clear()
-        self._after_flush(n)
         return n
 
     def compact(self) -> None:
@@ -436,7 +463,7 @@ class MapServingEngine(ServingEngineBase):
             self.store.key_slot(row, contents["key"])  # reserve (KeyError
             # on key-capacity exhaustion → CAPACITY nack before logging)
 
-    def flush(self) -> int:
+    def _flush_impl(self) -> int:
         n = len(self._queue)
         if self._queue:
             self.store.apply_batch(
@@ -444,7 +471,6 @@ class MapServingEngine(ServingEngineBase):
                  m.contents.get("key"), m.contents.get("value"), m.seq)
                 for row, m in self._queue)
             self._queue.clear()
-        self._after_flush(n)
         return n
 
     # ----------------------------------------------------------------- reads
@@ -575,13 +601,12 @@ class MatrixServingEngine(ServingEngineBase):
 
     # ----------------------------------------------------------- device side
 
-    def flush(self) -> int:
+    def _flush_impl(self) -> int:
         """Walk the window in seq order: permutation ops advance the host
         axes, setCells resolve to stable keys (and pass the FWW filter),
         then ONE device merge applies the surviving cell writes."""
         n = len(self._queue)
         if not n:
-            self._after_flush(n)
             return n
         self._queue.sort(key=lambda dm: dm[1].seq)
         records = []
@@ -598,7 +623,6 @@ class MatrixServingEngine(ServingEngineBase):
         self._pending_setcells = 0
         if records:
             self.store.apply_batch(records)
-        self._after_flush(n)
         return n
 
     def overflowed(self) -> bool:

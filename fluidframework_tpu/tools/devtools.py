@@ -1,0 +1,80 @@
+"""Devtools: live inspection of containers and serving engines.
+
+Reference counterpart: ``@fluidframework/devtools`` (container devtools —
+visualize container state, data stores, DDS contents, connection/audience)
+and the server's per-lambda metrics endpoints (SURVEY.md §5.5). These are
+plain-dict inspectors so any host (REPL, notebook, log line, HTTP handler)
+can render them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def inspect_container(container) -> dict:
+    """Snapshot of one loader-level ``Container``: connection state,
+    sequence window, quorum membership, and the datastore/channel tree
+    with per-channel type and summary shape."""
+    runtime = container.runtime
+    out: Dict[str, Any] = {
+        "state": getattr(container.state, "name", str(container.state)),
+        "clientId": getattr(runtime, "client_id", None),
+        "connected": getattr(runtime, "connected", None),
+        "lastSeq": getattr(runtime, "last_seq", None),
+        "minSeq": getattr(runtime, "min_seq", None),
+        "pendingOps": runtime.pending.pending_count
+        if getattr(runtime, "pending", None) is not None else 0,
+        "quorum": sorted(getattr(container.protocol.quorum, "members",
+                                 {}) or []),
+        "dataStores": {},
+    }
+    for ds_id, ds in sorted(getattr(runtime, "datastores", {}).items()):
+        out["dataStores"][ds_id] = {
+            "channels": {
+                ch_id: _channel_view(ch)
+                for ch_id, ch in sorted(ds._channels.items())
+            },
+        }
+    return out
+
+
+def _channel_view(channel) -> dict:
+    view: Dict[str, Any] = {"type": channel.TYPE}
+    # best-effort content shape per DDS family (never raises)
+    try:
+        if hasattr(channel, "get_text"):
+            view["length"] = channel.get_length()
+        elif hasattr(channel, "kernel") and hasattr(channel.kernel, "data"):
+            view["keys"] = len(channel.kernel.data)
+        elif hasattr(channel, "row_count"):
+            view["dims"] = [channel.row_count, channel.col_count]
+        elif hasattr(channel, "to_dict"):
+            view["nodes"] = len(channel)
+    except Exception:
+        pass
+    return view
+
+
+def inspect_engine(engine) -> dict:
+    """Snapshot of a serving engine: documents, queue depth, device slot
+    usage/overflow, and the metrics counters/percentiles (the Prometheus
+    analog)."""
+    out: Dict[str, Any] = {
+        "documents": sorted(engine._doc_rows),
+        "queueDepth": engine._queued(),
+        "metrics": engine.metrics.snapshot(),
+        "attribution": engine._attributors is not None,
+    }
+    store = getattr(engine, "store", None)
+    if store is not None and hasattr(store, "slot_usage"):
+        usage = store.slot_usage()
+        out["slotUsage"] = {"max": int(usage.max()),
+                            "total": int(usage.sum()),
+                            "capacity": store.capacity}
+        out["overflowedDocs"] = engine.overflowed_docs() \
+            if hasattr(engine, "overflowed_docs") else []
+    mega = getattr(engine, "_mega_rows", None)
+    if mega:
+        out["megaDocs"] = sorted(mega)
+    return out

@@ -184,3 +184,28 @@ def test_deli_restore_keeps_injected_clock():
     d2 = DeliSequencer.restore(d.checkpoint(), clock=d.clock)
     msg, _ = d2.sequence("x", 1, 1, 0, MessageType.OP, {})
     assert msg.timestamp == 42.0
+
+
+def test_mega_tier_attribution():
+    """attribution_at must work for mega-tier documents too (review
+    finding: MegaDocStringStore lacked seq_at)."""
+    engine = StringServingEngine(n_docs=1, capacity=64, mega_docs=1,
+                                 mega_capacity_per_shard=32)
+    engine.enable_attribution()
+    engine.connect("huge", 5)
+    engine.mark_mega("huge")
+    c = SequenceClient(5)
+    op = c.insert_text_local(0, "mega")
+    msg, nack = engine.submit("huge", 5, op["clientSeq"], 0, op)
+    assert nack is None
+    c.apply_msg(msg)
+    op = c.insert_text_local(4, "-doc")
+    msg, nack = engine.submit("huge", 5, op["clientSeq"],
+                              c.last_processed_seq, op)
+    assert nack is None
+    assert engine.read_text("huge") == "mega-doc"
+    for pos in range(8):
+        info = engine.attribution_at("huge", pos)
+        assert info.client_id == 5 and info.timestamp is not None
+    with pytest.raises(IndexError):
+        engine.attribution_at("huge", 99)

@@ -153,3 +153,50 @@ class TestReplayTool:
         assert main([recorded]) == 0
         out = capsys.readouterr().out
         assert "ops_per_sec=" in out and "doc=doc" in out
+
+
+# ------------------------------------------------------------------ devtools
+
+class TestDevtools:
+    def test_inspect_container(self):
+        from fluidframework_tpu.framework import LocalClient
+        from fluidframework_tpu.tools.devtools import inspect_container
+        client = LocalClient()
+        fc, doc_id = client.create_container(
+            {"initialObjects": {"text": "sharedString", "m": "map"}})
+        fc.initial_objects["text"].insert_text(0, "hello")
+        fc.initial_objects["m"].set("k", 1)
+        view = inspect_container(fc.container)
+        assert view["state"] in ("LOADED", "CONNECTED")
+        assert view["connected"] is True
+        assert view["lastSeq"] >= 1
+        channels = view["dataStores"]["default"]["channels"]
+        assert channels["text"]["type"] == "sharedString"
+        assert channels["text"]["length"] == 5
+        assert channels["m"]["keys"] == 1
+        assert view["pendingOps"] == 0  # local service delivers synchronously
+
+    def test_inspect_engine_metrics(self):
+        from fluidframework_tpu.models.merge_tree_client import SequenceClient
+        from fluidframework_tpu.server.serving import StringServingEngine
+        from fluidframework_tpu.tools.devtools import inspect_engine
+        engine = StringServingEngine(n_docs=2, capacity=128, batch_window=4)
+        engine.connect("d", 1)
+        c = SequenceClient(1)
+        for i in range(9):
+            op = c.insert_text_local(c.get_length(), "ab")
+            msg, _ = engine.submit("d", 1, op["clientSeq"],
+                                   c.last_processed_seq, op)
+            c.apply_msg(msg)
+        # a nack for the metrics counter
+        engine.submit("d", 99, 1, 0, {"mt": "remove", "start": 0, "end": 1})
+        engine.flush()
+        view = inspect_engine(engine)
+        assert view["documents"] == ["d"]
+        m = view["metrics"]
+        assert m["ops_ingested"] == 9
+        assert m["nacks"] == 1 and m["nacks_unknown_client"] == 1
+        assert m["ops_flushed"] == 9 and m["flushes"] >= 2
+        assert m["flush_ms_count"] >= 2 and m["flush_ms_p99_ms"] > 0
+        assert view["slotUsage"]["max"] >= 1
+        assert view["overflowedDocs"] == []
