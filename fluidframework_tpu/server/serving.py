@@ -122,6 +122,7 @@ class ServingEngineBase:
         msg, nack = self.deli.sequence(
             doc_id, client_id, client_seq, ref_seq, MessageType.OP, contents)
         if nack is not None:
+            self._unadmit(doc_id, contents)
             return self._nacked(nack)
         self.metrics.inc("ops_ingested")
         self._log_append(doc_id, msg)
@@ -141,11 +142,20 @@ class ServingEngineBase:
         """Subclasses reject op shapes their flush path cannot apply."""
         return True
 
+    @staticmethod
+    def _is_nat(v, lo: int = 0) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= lo
+
     def _admit(self, doc_id: str, contents: Any) -> None:
         """Reserve the capacity the op will need at flush (doc row here;
         subclasses add store-specific reservations like key slots). Raises
         KeyError on exhaustion → the op is nacked before it is logged."""
         self.doc_row(doc_id)
+
+    def _unadmit(self, doc_id: str, contents: Any) -> None:
+        """Undo ``_admit``'s reservations when the sequencer nacks AFTER
+        admission — otherwise a stream of deli-nacked ops (stale ref_seq,
+        clientSeq gaps) leaks capacity that was never used."""
 
     def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
         self.log.append(partition_of(doc_id, self.log.n_partitions), msg)
@@ -285,6 +295,67 @@ class StringServingEngine(ServingEngineBase):
         self._mega_rows[doc_id] = len(self._mega_rows)
 
     # --------------------------------------------------------------- ingress
+
+    @classmethod
+    def _valid_props(cls, props, required: bool) -> bool:
+        if props is None:
+            return not required
+        if not (isinstance(props, dict) and
+                all(isinstance(k, str) for k in props)):
+            return False
+        if required and not props:
+            return False
+        try:  # flush JSON-interns values: reject unserializable now
+            json.dumps(props)
+        except (TypeError, ValueError):
+            return False
+        return True
+
+    def _valid_op(self, contents: Any) -> bool:
+        """Full structural validation BEFORE sequencing/logging: a logged op
+        the flush path cannot turn into device records would poison the
+        engine and its recovery replay (the submit() invariant)."""
+        if not isinstance(contents, dict):
+            return False
+        mt = contents.get("mt")
+        if mt == "insert":
+            kind = contents.get("kind")
+            if not (self._is_nat(kind) and kind in (0, 1)
+                    and self._is_nat(contents.get("pos"))):
+                return False
+            if contents["kind"] == 0 and \
+                    not isinstance(contents.get("text"), str):
+                return False
+            return self._valid_props(contents.get("props"), required=False)
+        if mt == "remove":
+            return (self._is_nat(contents.get("start"))
+                    and self._is_nat(contents.get("end"))
+                    and contents["start"] < contents["end"])
+        if mt == "annotate":
+            return (self._is_nat(contents.get("start"))
+                    and self._is_nat(contents.get("end"))
+                    and contents["start"] < contents["end"]
+                    and self._valid_props(contents.get("props"),
+                                          required=True))
+        return False
+
+    def _admit(self, doc_id: str, contents: Any) -> None:
+        """Row + property-interner reservation (KeyError → CAPACITY nack
+        before the op is logged): an annotate whose key cannot get a plane
+        would otherwise raise at flush. The reservation is transactional —
+        ``_unadmit`` refunds it if the sequencer nacks afterwards."""
+        self.doc_row(doc_id)
+        self._admit_token = None
+        props = contents.get("props")
+        if props:
+            store, _ = self._store_of(doc_id)
+            self._admit_token = (store, store.reserve_props(props))
+
+    def _unadmit(self, doc_id: str, contents: Any) -> None:
+        if getattr(self, "_admit_token", None) is not None:
+            store, minted = self._admit_token
+            store.release_props(minted)
+        self._admit_token = None
 
     def _enqueue(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
         row = self.doc_row(doc_id)
@@ -542,10 +613,6 @@ class MatrixServingEngine(ServingEngineBase):
     # structural bound on one axis op (an insert allocates count slots on
     # the host axis — an unbounded count is a memory-exhaustion vector)
     MAX_AXIS_COUNT = 1 << 20
-
-    @staticmethod
-    def _is_nat(v, lo=0) -> bool:
-        return isinstance(v, int) and not isinstance(v, bool) and v >= lo
 
     def _valid_op(self, contents: Any) -> bool:
         """Full structural validation BEFORE sequencing/logging: every field

@@ -101,3 +101,66 @@ def test_pallas_fused_compaction_matches_xla_apply_then_compact():
                         (k, seed, r, d)
             assert np.array_equal(np.asarray(string_state_digest(sp)),
                                   np.asarray(string_state_digest(sx)))
+
+
+def test_store_product_path_runs_pallas():
+    """The PRODUCT path (TensorStringStore._dispatch_apply, VERDICT r1 #1):
+    the same multi-client message stream through the Pallas-interpret store
+    and the XLA store must converge to identical text and digests."""
+    from fluidframework_tpu.ops.string_store import (
+        TensorStringStore, pallas_tile_for,
+    )
+    from tests.test_merge_tree_kernel import collab_stream
+
+    assert pallas_tile_for(8, 256) == 8
+    assert pallas_tile_for(10240, 384) == 128
+    assert pallas_tile_for(7, 256) is None      # doc count not tileable
+    assert pallas_tile_for(8, 200) is None      # capacity not lane-aligned
+
+    text, length, msgs = collab_stream(7, n_rounds=10)
+    a = TensorStringStore(n_docs=8, capacity=256)
+    a.pallas = "interpret"
+    b = TensorStringStore(n_docs=8, capacity=256)
+    b.pallas = "off"
+    for store in (a, b):
+        store.apply_messages((3, m) for m in msgs)
+    assert a.read_text(3) == text == b.read_text(3)
+    assert a.visible_length(3) == length
+    assert np.array_equal(a.digests(), b.digests())
+
+
+def test_store_pallas_falls_back_on_annotate():
+    """A store that sees an annotate must leave the fused no-props kernel
+    and still converge (the one-way _has_props transition)."""
+    from fluidframework_tpu.ops.string_store import TensorStringStore
+    from tests.test_merge_tree_kernel import collab_stream
+
+    text, _, msgs = collab_stream(11, n_rounds=10, with_annotates=True)
+    store = TensorStringStore(n_docs=8, capacity=512)
+    store.pallas = "interpret"
+    store.apply_messages((0, m) for m in msgs)
+    assert store.read_text(0) == text
+
+
+def test_replicated_step_pallas_matches_xla():
+    """Multi-chip step on the fused kernel (VERDICT r1 #1): per-shard Pallas
+    apply under shard_map agrees with the single-device XLA scan."""
+    from fluidframework_tpu.ops.merge_tree_kernel import string_state_digest
+    from fluidframework_tpu.parallel import (
+        make_mesh, make_replicated_step, shard_state, shard_ops,
+    )
+
+    mesh = make_mesh(8)
+    _, doc_shards = mesh.devices.shape
+    n_docs, n_ops, cap = 8 * doc_shards, 8, 128
+    planes, _ = typing_storm(n_docs, n_ops, seed=5)
+    ops = tuple(jnp.asarray(planes[k]) for k in ORDER)
+
+    single = apply_string_batch(StringState.create(n_docs, cap), *ops)
+    step = make_replicated_step(mesh, with_props=False, use_pallas=True,
+                                pallas_tile=8, pallas_interpret=True)
+    state = shard_state(StringState.create(n_docs, cap), mesh)
+    new_state, digest, agree = step(state, *shard_ops(mesh, *ops))
+    assert int(agree) == 1
+    assert np.array_equal(np.asarray(digest),
+                          np.asarray(string_state_digest(single)))
