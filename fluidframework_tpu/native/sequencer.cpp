@@ -42,6 +42,11 @@ struct DocState {
 
 struct Deli {
   std::unordered_map<std::string, DocState> docs;
+  // Row-handle interning for the columnar ingest path: a handle is a dense
+  // int32 resolving straight to the DocState without per-op string hashing.
+  // unordered_map nodes are pointer-stable, so the raw pointers stay valid.
+  std::vector<DocState*> by_handle;
+  std::unordered_map<std::string, int32_t> handle_of;
 };
 
 // nack codes (match server/deli.py NackReason, offset to negatives)
@@ -49,6 +54,31 @@ constexpr int64_t kNackUnknownClient = -1;
 constexpr int64_t kNackClientSeqGap = -2;
 constexpr int64_t kNackDuplicate = -3;
 constexpr int64_t kNackRefSeqBelowMsn = -4;
+
+// One op's stamping against a resolved DocState — shared by the string-keyed
+// single-op path and the handle-keyed batch path.
+inline int64_t sequence_on(DocState& doc, int32_t client, int32_t client_seq,
+                           int32_t ref_seq, int32_t is_noop,
+                           int64_t* out_min_seq) {
+  auto it = doc.clients.find(client);
+  if (it == doc.clients.end()) return kNackUnknownClient;
+  ClientState& cs = it->second;
+  if (!is_noop) {
+    const int32_t expected = cs.last_client_seq + 1;
+    if (client_seq < expected) return kNackDuplicate;
+    if (client_seq > expected) return kNackClientSeqGap;
+  }
+  if (ref_seq < doc.min_seq) return kNackRefSeqBelowMsn;
+  // clamp: a ref_seq above the current doc seq would inflate the MSN past
+  // seq and permanently nack every later op (client cannot see the future)
+  if (ref_seq > doc.seq) ref_seq = static_cast<int32_t>(doc.seq);
+  if (!is_noop) cs.last_client_seq = client_seq;
+  if (ref_seq > cs.ref_seq) cs.ref_seq = ref_seq;
+  doc.seq += 1;
+  doc.min_seq = doc.compute_msn();
+  if (out_min_seq != nullptr) *out_min_seq = doc.min_seq;
+  return doc.seq;
+}
 
 }  // namespace
 
@@ -82,24 +112,71 @@ int64_t deli_sequence(void* h, const char* doc_id, int32_t client,
                       int32_t client_seq, int32_t ref_seq, int32_t is_noop,
                       int64_t* out_min_seq) {
   auto& doc = static_cast<Deli*>(h)->docs[doc_id];
-  auto it = doc.clients.find(client);
-  if (it == doc.clients.end()) return kNackUnknownClient;
-  ClientState& cs = it->second;
-  if (!is_noop) {
-    const int32_t expected = cs.last_client_seq + 1;
-    if (client_seq < expected) return kNackDuplicate;
-    if (client_seq > expected) return kNackClientSeqGap;
+  return sequence_on(doc, client, client_seq, ref_seq, is_noop, out_min_seq);
+}
+
+// Dense row handle for a document (registers it on first use) — resolves a
+// doc without string hashing on the per-op path. Handles are session-local:
+// they do NOT survive checkpoint/restore (re-register after restore).
+int32_t deli_doc_handle(void* h, const char* doc_id) {
+  auto* deli = static_cast<Deli*>(h);
+  auto it = deli->handle_of.find(doc_id);
+  if (it != deli->handle_of.end()) return it->second;
+  DocState* doc = &deli->docs[doc_id];
+  const int32_t handle = static_cast<int32_t>(deli->by_handle.size());
+  deli->by_handle.push_back(doc);
+  deli->handle_of.emplace(doc_id, handle);
+  return handle;
+}
+
+// Columnar ingest: stamp n ops across many documents in one call (the
+// host-side hot loop feeding the TPU batch). out_seqs[i] < 0 = nack code;
+// out_min_seqs[i] = the doc's MSN after op i either way.
+void deli_sequence_batch_rows(void* h, int32_t n, const int32_t* handles,
+                              const int32_t* clients,
+                              const int32_t* client_seqs,
+                              const int32_t* ref_seqs, const int32_t* is_noop,
+                              int64_t* out_seqs, int64_t* out_min_seqs) {
+  auto* deli = static_cast<Deli*>(h);
+  const int32_t n_handles = static_cast<int32_t>(deli->by_handle.size());
+  for (int32_t i = 0; i < n; ++i) {
+    if (handles[i] < 0 || handles[i] >= n_handles) {
+      // stale handle (they do not survive restore): nack, don't crash
+      out_seqs[i] = kNackUnknownClient;
+      out_min_seqs[i] = 0;
+      continue;
+    }
+    DocState& doc = *deli->by_handle[handles[i]];
+    out_seqs[i] = sequence_on(doc, clients[i], client_seqs[i], ref_seqs[i],
+                              is_noop ? is_noop[i] : 0, &out_min_seqs[i]);
+    if (out_seqs[i] < 0) out_min_seqs[i] = doc.min_seq;
   }
-  if (ref_seq < doc.min_seq) return kNackRefSeqBelowMsn;
-  // clamp: a ref_seq above the current doc seq would inflate the MSN past
-  // seq and permanently nack every later op (client cannot see the future)
-  if (ref_seq > doc.seq) ref_seq = static_cast<int32_t>(doc.seq);
-  if (!is_noop) cs.last_client_seq = client_seq;
-  if (ref_seq > cs.ref_seq) cs.ref_seq = ref_seq;
-  doc.seq += 1;
-  doc.min_seq = doc.compute_msn();
-  if (out_min_seq != nullptr) *out_min_seq = doc.min_seq;
-  return doc.seq;
+}
+
+// Re-apply an already-sequenced message to sequencer state (log-tail replay
+// after restoring an older checkpoint). type matches MessageType: 1 = NOOP,
+// 2 = CLIENT_JOIN, 3 = CLIENT_LEAVE, anything else = a sequenced op.
+void deli_replay(void* h, const char* doc_id, int32_t client,
+                 int32_t client_seq, int32_t ref_seq, int64_t seq,
+                 int64_t min_seq, int32_t type) {
+  auto& doc = static_cast<Deli*>(h)->docs[doc_id];
+  if (type == 2) {
+    ClientState cs;
+    cs.ref_seq = ref_seq;
+    doc.clients[client] = cs;
+  } else if (type == 3) {
+    doc.clients.erase(client);
+  } else {
+    auto it = doc.clients.find(client);
+    if (it != doc.clients.end()) {
+      if (type != 1 && client_seq > it->second.last_client_seq) {
+        it->second.last_client_seq = client_seq;
+      }
+      if (ref_seq > it->second.ref_seq) it->second.ref_seq = ref_seq;
+    }
+  }
+  if (seq > doc.seq) doc.seq = seq;
+  if (min_seq > doc.min_seq) doc.min_seq = min_seq;
 }
 
 // Batch stamping for one document: the TPU-ingest hot path. out_seqs[i] gets

@@ -377,6 +377,12 @@ def compact_string_state(state: StringState, min_seq,
     return StringState(**out)
 
 
+# jitted zamboni: an un-jitted call runs dozens of eager dispatches —
+# ruinous over a remote-tunnel device link (each pays the RTT)
+compact_string_state_jit = jax.jit(compact_string_state, donate_argnums=0,
+                                   static_argnames=("with_props",))
+
+
 def string_state_digest(state: StringState) -> jax.Array:
     """Per-doc content digest, invariant to split boundaries: for a live run
     (handle_op, handle_off) at visible position pos, (handle_off - pos) is

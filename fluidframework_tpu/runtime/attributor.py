@@ -39,6 +39,12 @@ class Attributor:
             self._entries[msg.seq] = AttributionInfo(
                 msg.client_id, msg.timestamp)
 
+    def record_raw(self, seq: int, client_id: int,
+                   timestamp: Optional[float]) -> None:
+        """Columnar-ingest variant of ``record`` (no message object)."""
+        if client_id >= 0:
+            self._entries[seq] = AttributionInfo(client_id, timestamp)
+
     def get(self, seq: int) -> AttributionInfo:
         try:
             return self._entries[seq]

@@ -50,6 +50,17 @@ def _load():
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.deli_doc_handle.restype = ctypes.c_int32
+    lib.deli_doc_handle.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.deli_sequence_batch_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.deli_replay.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
     lib.deli_doc_seq.restype = ctypes.c_int64
     lib.deli_doc_seq.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.deli_doc_min_seq.restype = ctypes.c_int64
@@ -122,6 +133,37 @@ class NativeDeli:
             p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
         return out_seq, out_min
 
+    def doc_handle(self, doc_id: str) -> int:
+        """Dense row handle (session-local; re-register after restore)."""
+        return int(self._lib.deli_doc_handle(self._h, doc_id.encode()))
+
+    def sequence_batch_rows(self, handles, clients, client_seqs, ref_seqs,
+                            is_noop=None):
+        """Columnar multi-doc stamping: one C call for the whole batch.
+        Returns (seqs, min_seqs) int64 arrays; negative seq = nack code."""
+        handles = np.ascontiguousarray(handles, np.int32)
+        clients = np.ascontiguousarray(clients, np.int32)
+        client_seqs = np.ascontiguousarray(client_seqs, np.int32)
+        ref_seqs = np.ascontiguousarray(ref_seqs, np.int32)
+        n = len(handles)
+        if is_noop is None:
+            is_noop = np.zeros(n, np.int32)
+        is_noop = np.ascontiguousarray(is_noop, np.int32)
+        out_seq = np.empty(n, np.int64)
+        out_min = np.empty(n, np.int64)
+        p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+        self._lib.deli_sequence_batch_rows(
+            self._h, n, p(handles, ctypes.c_int32),
+            p(clients, ctypes.c_int32), p(client_seqs, ctypes.c_int32),
+            p(ref_seqs, ctypes.c_int32), p(is_noop, ctypes.c_int32),
+            p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
+        return out_seq, out_min
+
+    def replay(self, doc_id: str, client: int, client_seq: int,
+               ref_seq: int, seq: int, min_seq: int, type_: int) -> None:
+        self._lib.deli_replay(self._h, doc_id.encode(), client, client_seq,
+                              ref_seq, seq, min_seq, type_)
+
     def doc_seq(self, doc_id: str) -> int:
         return int(self._lib.deli_doc_seq(self._h, doc_id.encode()))
 
@@ -141,3 +183,74 @@ class NativeDeli:
             raise RuntimeError("native sequencer unavailable")
         h = lib.deli_restore(blob, len(blob))
         return cls(_handle=h)
+
+
+class NativeDeliAdapter:
+    """The C++ sequencer behind the Python ``DeliSequencer`` surface, so a
+    serving engine can swap it in wholesale (``sequencer="native"``): the
+    per-op path pays one ctypes call instead of Python dict bookkeeping, and
+    the columnar ingest path (``raw``) stamps whole batches in one C call
+    against the SAME state — one source of truth.
+
+    Checkpoint format is the native text blob wrapped as
+    ``{"native": <latin1 str>}``; ``restore_sequencer`` (server.serving)
+    dispatches on that key, so python-engine summaries keep loading into
+    python sequencers and native into native."""
+
+    def __init__(self, clock=None, _native: Optional[NativeDeli] = None):
+        import time
+        self.raw = _native if _native is not None else NativeDeli()
+        self.clock = clock if clock is not None else time.time
+
+    def client_join(self, doc_id: str, client_id: int):
+        from ..core.protocol import MessageType, SequencedDocumentMessage
+        seq = self.raw.client_join(doc_id, client_id)
+        return SequencedDocumentMessage(
+            doc_id=doc_id, client_id=client_id, client_seq=0,
+            ref_seq=seq - 1, seq=seq,
+            min_seq=self.raw.doc_min_seq(doc_id),
+            type=MessageType.CLIENT_JOIN, contents={"clientId": client_id})
+
+    def client_leave(self, doc_id: str, client_id: int):
+        from ..core.protocol import MessageType, SequencedDocumentMessage
+        seq = self.raw.client_leave(doc_id, client_id)
+        if seq == 0:
+            return None
+        return SequencedDocumentMessage(
+            doc_id=doc_id, client_id=client_id, client_seq=0, ref_seq=seq,
+            seq=seq, min_seq=self.raw.doc_min_seq(doc_id),
+            type=MessageType.CLIENT_LEAVE, contents={"clientId": client_id})
+
+    def sequence(self, doc_id: str, client_id: int, client_seq: int,
+                 ref_seq: int, type, contents, address=None):
+        from ..core.protocol import MessageType, SequencedDocumentMessage
+        from .deli import Nack
+        seq, min_seq, reason = self.raw.sequence(
+            doc_id, client_id, client_seq, ref_seq,
+            is_noop=(type == MessageType.NOOP))
+        if reason is not None:
+            return None, Nack(doc_id, client_id, client_seq, reason)
+        # mirror the C++ clamp so the broadcast message carries what the
+        # sequencer actually recorded
+        msg = SequencedDocumentMessage(
+            doc_id=doc_id, client_id=client_id, client_seq=client_seq,
+            ref_seq=min(ref_seq, seq - 1), seq=seq, min_seq=min_seq,
+            type=type, contents=contents, address=address,
+            timestamp=self.clock())
+        return msg, None
+
+    def replay(self, msg) -> None:
+        self.raw.replay(msg.doc_id, msg.client_id, msg.client_seq,
+                        msg.ref_seq, msg.seq, msg.min_seq, int(msg.type))
+
+    def doc_seq(self, doc_id: str) -> int:
+        return self.raw.doc_seq(doc_id)
+
+    def checkpoint(self) -> dict:
+        return {"native": self.raw.checkpoint().decode("latin1")}
+
+    @classmethod
+    def restore(cls, snapshot: dict, clock=None) -> "NativeDeliAdapter":
+        return cls(clock=clock,
+                   _native=NativeDeli.restore(
+                       snapshot["native"].encode("latin1")))

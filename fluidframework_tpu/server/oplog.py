@@ -11,10 +11,26 @@ lambda re-reads from its checkpointed offset).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import threading
 from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _spill_json(o):
+    """Lossless JSONL spill encoding: numpy arrays become full lists (the
+    default str() repr elides long arrays — unrecoverable), dataclass
+    records (SequencedDocumentMessage, ColumnarOps) become dicts."""
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, (np.integer, np.floating)):
+        return o.item()
+    if dataclasses.is_dataclass(o) and not isinstance(o, type):
+        return {"__type__": type(o).__name__, **dataclasses.asdict(o)}
+    return str(o)
 
 
 def partition_of(doc_id: str, n_partitions: int) -> int:
@@ -55,7 +71,7 @@ class PartitionedLog:
             part.append(record)
             if self._spill is not None:
                 self._spill[partition].write(
-                    json.dumps(record, default=str) + "\n")
+                    json.dumps(record, default=_spill_json) + "\n")
                 self._spill[partition].flush()
             for fn in list(self._subs[partition]):
                 fn(partition, offset, record)

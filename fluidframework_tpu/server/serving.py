@@ -22,6 +22,7 @@ latency exactly like the reference's outbox flush policy.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -38,6 +39,67 @@ from .deli import DeliSequencer, Nack, NackReason
 from .oplog import PartitionedLog, partition_of
 
 
+def make_sequencer(kind: str = "python", clock=None):
+    """Engine sequencer factory: "python" = the reference-semantics
+    DeliSequencer; "native" = the C++ sequencer behind the same surface
+    (falls back to Python when no toolchain can build it)."""
+    if kind == "native":
+        from . import native_deli
+        if native_deli.available():
+            return native_deli.NativeDeliAdapter(clock=clock)
+    return DeliSequencer(clock=clock)
+
+
+def restore_sequencer(snapshot: dict, clock=None):
+    """Checkpoint-format dispatch: native blobs restore into the native
+    sequencer, python dicts into the Python one."""
+    if "native" in snapshot:
+        from .native_deli import NativeDeliAdapter
+        return NativeDeliAdapter.restore(snapshot, clock=clock)
+    return DeliSequencer.restore(snapshot, clock=clock)
+
+
+@dataclasses.dataclass
+class ColumnarOps:
+    """A columnar (struct-of-arrays) run of sequenced string ops in the
+    durable log — ONE record per (ingest batch × partition) instead of one
+    Python object per op (the Kafka batch-append analog). Replay expands it
+    back into per-op messages (recovery is rare; ingest is hot)."""
+
+    doc_ids: List[str]          # row-local doc-id table
+    doc: np.ndarray             # (N,) index into doc_ids
+    client: np.ndarray          # (N,)
+    client_seq: np.ndarray      # (N,)
+    ref_seq: np.ndarray         # (N,)
+    seq: np.ndarray             # (N,)
+    min_seq: np.ndarray         # (N,)
+    kind: np.ndarray            # (N,) OpKind (STR_INSERT / STR_REMOVE)
+    a0: np.ndarray              # (N,)
+    a1: np.ndarray              # (N,)
+    text: str                   # broadcast insert payload
+    timestamp: float = 0.0
+
+    def expand(self):
+        """Per-op SequencedDocumentMessage stream (log-tail replay)."""
+        out = []
+        for i in range(len(self.seq)):
+            k = int(self.kind[i])
+            if k == OpKind.STR_INSERT:
+                contents = {"mt": "insert", "kind": 0, "pos": int(self.a0[i]),
+                            "text": self.text}
+            else:
+                contents = {"mt": "remove", "start": int(self.a0[i]),
+                            "end": int(self.a1[i])}
+            out.append(SequencedDocumentMessage(
+                doc_id=self.doc_ids[int(self.doc[i])],
+                client_id=int(self.client[i]),
+                client_seq=int(self.client_seq[i]),
+                ref_seq=int(self.ref_seq[i]), seq=int(self.seq[i]),
+                min_seq=int(self.min_seq[i]), type=MessageType.OP,
+                contents=contents, timestamp=self.timestamp))
+        return out
+
+
 class ServingEngineBase:
     """The DDS-agnostic half of a serving engine: Deli sequencing, the
     durable partitioned log, doc-row membership, window-floor tracking, and
@@ -46,8 +108,9 @@ class ServingEngineBase:
 
     def __init__(self, batch_window: int = 64, n_partitions: int = 8,
                  compact_every: int = 16,
-                 log: Optional[PartitionedLog] = None):
-        self.deli = DeliSequencer()
+                 log: Optional[PartitionedLog] = None,
+                 sequencer: str = "python"):
+        self.deli = make_sequencer(sequencer)
         self.log = log if log is not None else PartitionedLog(n_partitions)
         self.batch_window = batch_window
         self.compact_every = compact_every
@@ -214,8 +277,8 @@ class ServingEngineBase:
 
     def _restore_base(self, summary: dict) -> None:
         # keep the engine's (possibly injected deterministic) clock
-        self.deli = DeliSequencer.restore(summary["deli"],
-                                          clock=self.deli.clock)
+        self.deli = restore_sequencer(summary["deli"],
+                                      clock=self.deli.clock)
         self._doc_rows = dict(summary["doc_rows"])
         self._min_seq = dict(summary["min_seq"])
         if summary.get("attribution") is not None:
@@ -230,14 +293,17 @@ class ServingEngineBase:
         ``control_hook(msg) -> True`` consumes engine-specific control
         records before they reach the stores."""
         for p in range(self.log.n_partitions):
-            for msg in self.log.read(p, from_offset=summary["log_offsets"][p]):
-                self.deli.replay(msg)
-                self._record_attribution(msg)
-                if control_hook is not None and control_hook(msg):
-                    continue
-                if msg.type == MessageType.OP:
-                    self._enqueue(msg.doc_id, msg)
-                    self._min_seq[msg.doc_id] = msg.min_seq
+            for rec in self.log.read(p,
+                                     from_offset=summary["log_offsets"][p]):
+                msgs = rec.expand() if isinstance(rec, ColumnarOps) else (rec,)
+                for msg in msgs:
+                    self.deli.replay(msg)
+                    self._record_attribution(msg)
+                    if control_hook is not None and control_hook(msg):
+                        continue
+                    if msg.type == MessageType.OP:
+                        self._enqueue(msg.doc_id, msg)
+                        self._min_seq[msg.doc_id] = msg.min_seq
         self._queue.sort(key=lambda dm: dm[1].seq)
 
 
@@ -250,8 +316,14 @@ class StringServingEngine(ServingEngineBase):
                  log: Optional[PartitionedLog] = None,
                  store: Optional[TensorStringStore] = None,
                  mega_docs: int = 0, mega_capacity_per_shard: int = 256,
-                 mega_store=None):
-        super().__init__(batch_window, n_partitions, compact_every, log)
+                 mega_store=None, sequencer: str = "python"):
+        super().__init__(batch_window, n_partitions, compact_every, log,
+                         sequencer=sequencer)
+        # columnar-ingest row caches (doc id / native handle / partition by
+        # flat-tier row), filled as rows are allocated
+        self._row_doc_id: List[Optional[str]] = [None] * n_docs
+        self._row_handle = np.full(n_docs, -1, np.int32)
+        self._row_part = np.zeros(n_docs, np.int32)
         self.store = store if store is not None \
             else TensorStringStore(n_docs, capacity, n_props)
         # mega tier: documents too long for one chip's slot budget are
@@ -271,7 +343,11 @@ class StringServingEngine(ServingEngineBase):
     def doc_row(self, doc_id: str) -> int:
         if doc_id in self._mega_rows:
             return self._mega_rows[doc_id]
-        return super().doc_row(doc_id)
+        row = super().doc_row(doc_id)
+        if self._row_doc_id[row] is None:
+            self._row_doc_id[row] = doc_id
+            self._row_part[row] = partition_of(doc_id, self.log.n_partitions)
+        return row
 
     def mark_mega(self, doc_id: str) -> None:
         """Route this document to the segment-axis-sharded mega tier (must
@@ -385,6 +461,127 @@ class StringServingEngine(ServingEngineBase):
                         and store._intervals[row]:
                     self.flush()
                     store.advance_min_seq(row, msg.min_seq)
+
+    # ------------------------------------------------------- columnar ingest
+
+    def ingest_planes(self, rows, client, client_seq, ref_seq, kind, a0, a1,
+                      text: str) -> dict:
+        """The high-throughput ingest path: a dense (R, O) columnar batch of
+        RAW client string ops — sequenced in ONE native C call, bulk-appended
+        to the durable log as per-partition ``ColumnarOps`` records, and
+        merged in ONE device dispatch. This is the same submit→log→flush
+        pipeline as ``submit``, minus per-op Python objects (SURVEY.md §7.5:
+        the low-jitter host loop feeding the device batch).
+
+        rows: (R,) flat-tier doc rows (allocate via ``doc_row``; clients must
+        have joined via ``connect``). client/client_seq/ref_seq/kind/a0/a1:
+        (R, O) int32 planes, ops of each doc in submission order. Inserts
+        insert the broadcast ``text`` (a1 is derived); removes use a0=start,
+        a1=end. Requires ``sequencer="native"``. Returns {"seq": (R, O)
+        int64 (negative = nack code), "nacked": int}. Nacked slots are
+        skipped everywhere (not logged, not applied)."""
+        raw = getattr(self.deli, "raw", None)
+        if raw is None:
+            raise RuntimeError("columnar ingest requires sequencer='native'")
+        self.flush()  # per-op queue first: per-doc seq order must hold
+        rows = np.ascontiguousarray(rows, np.int32)
+        R, O = kind.shape
+        if len(np.unique(rows)) != R:
+            raise ValueError("duplicate rows in columnar batch (the device "
+                             "scatter would silently drop ops)")
+        kind = np.asarray(kind, np.int32)
+        if not np.isin(kind, (int(OpKind.STR_INSERT),
+                              int(OpKind.STR_REMOVE))).all():
+            raise ValueError("columnar planes must be dense insert/remove")
+
+        if (self._row_handle[rows] < 0).any():  # fill handle cache once
+            for r in rows:
+                if self._row_handle[r] < 0:
+                    if self._row_doc_id[r] is None:
+                        raise KeyError(
+                            f"row {int(r)} has no document (allocate via "
+                            "doc_row before columnar ingest)")
+                    self._row_handle[r] = raw.doc_handle(self._row_doc_id[r])
+
+        t0 = time.perf_counter()
+        flat = lambda p: np.ascontiguousarray(np.asarray(p, np.int32)
+                                              .reshape(-1))
+        handles = np.repeat(self._row_handle[rows], O)
+        out_seq, out_min = raw.sequence_batch_rows(
+            handles, flat(client), flat(client_seq), flat(ref_seq))
+        nacked = out_seq < 0
+        n_ok = int((~nacked).sum())
+        self.metrics.inc("ops_ingested", n_ok)
+        if nacked.any():
+            self.metrics.inc("nacks", int(nacked.sum()))
+
+        # durable log: one ColumnarOps record per touched partition. The
+        # logged ref_seq is the CLAMPED one (min(ref, seq-1), what the
+        # sequencer recorded): replaying a raw inflated ref would push a
+        # client's ref_seq past doc.seq after recovery and permanently nack
+        # every later op (the clamp invariant in sequence_on).
+        ts = self.deli.clock()
+        rowidx = np.repeat(np.arange(R, dtype=np.int32), O)
+        parts = np.repeat(self._row_part[rows], O)
+        ids = [self._row_doc_id[r] for r in rows]
+        ref_clamped = np.minimum(flat(ref_seq).astype(np.int64),
+                                 np.maximum(out_seq - 1, 0))
+        fields = (flat(client), flat(client_seq), ref_clamped,
+                  out_seq, out_min, kind.reshape(-1), flat(a0), flat(a1))
+        for p in np.unique(parts):
+            sel = (parts == p) & ~nacked
+            if sel.any():
+                self.log.append(int(p), ColumnarOps(
+                    ids, rowidx[sel], *(f[sel] for f in fields),
+                    text=text, timestamp=ts))
+
+        # window-floor tracking for zamboni (last MSN per doc in the batch)
+        last_min = out_min.reshape(R, O)[:, -1]
+        for i, r in enumerate(rows):
+            self._min_seq[self._row_doc_id[r]] = int(last_min[i])
+
+        if self._attributors is not None:
+            ok = ~nacked
+            cl = flat(client)
+            for doc_local, s, c in zip(rowidx[ok], out_seq[ok], cl[ok]):
+                self._attributor_of(ids[int(doc_local)]).record_raw(
+                    int(s), int(c), ts)
+
+        # device merge: nacked slots become NOOP (they consumed no seq); the
+        # store rebuilds per-op seqs on device from each doc's base — only
+        # narrow planes cross the host→device link (ref clamps on device).
+        # On a compaction-due flush, zamboni fuses into the SAME dispatch.
+        valid_rs = (~nacked).reshape(R, O)
+        kind_eff = np.where(valid_rs, kind, int(OpKind.NOOP))
+        seq_rs = out_seq.reshape(R, O)
+        n_valid = valid_rs.sum(axis=1)
+        seq_base = (np.max(np.where(valid_rs, seq_rs, 0), axis=1)
+                    - n_valid).astype(np.int32)
+        compact_due = self._flushes_since_compact + 1 >= self.compact_every
+        ms_arr = None
+        if compact_due:
+            ms_arr = np.zeros((self.n_docs,), np.int32)
+            for doc_id, row in self._doc_rows.items():
+                ms_arr[row] = self._min_seq.get(doc_id, 0)
+        self.store.apply_planes(
+            rows, kind_eff, np.asarray(a0, np.int32),
+            np.asarray(a1, np.int32), seq_base,
+            np.asarray(client, np.int32),
+            np.asarray(ref_seq, np.int32), text, min_seq=ms_arr)
+        self.metrics.inc("flushes")
+        self.metrics.inc("ops_flushed", n_ok)
+        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        if compact_due:
+            self._flushes_since_compact = 0
+            self.metrics.inc("compactions")
+            if self.mega_store is not None and self._mega_rows:
+                mms = np.zeros((self.mega_store.n_docs,), np.int32)
+                for doc_id, row in self._mega_rows.items():
+                    mms[row] = self._min_seq.get(doc_id, 0)
+                self.mega_store.compact(mms)
+        else:
+            self._flushes_since_compact += 1
+        return {"seq": seq_rs, "nacked": int(nacked.sum())}
 
     # ----------------------------------------------------------- device side
 

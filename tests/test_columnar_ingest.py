@@ -1,0 +1,259 @@
+"""Columnar ingest path (ingest_planes): the per-op submit pipeline and the
+columnar pipeline must produce identical serving state — same sequencing
+policies (C++ vs Python Deli), same device merge, same durable-log recovery.
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops.schema import OpKind
+from fluidframework_tpu.server import native_deli
+from fluidframework_tpu.server.oplog import PartitionedLog
+from fluidframework_tpu.server.serving import ColumnarOps, StringServingEngine
+from fluidframework_tpu.testing.synthetic import typing_storm
+
+pytestmark = pytest.mark.skipif(not native_deli.available(),
+                                reason="native sequencer unavailable")
+
+TEXT = "abcd"  # typing_storm INS_LEN
+
+
+def _engines(R=8, O=16):
+    a = StringServingEngine(n_docs=R, capacity=256, batch_window=10 ** 9,
+                            sequencer="native")
+    b = StringServingEngine(n_docs=R, capacity=256, batch_window=10 ** 9)
+    docs = [f"doc-{i}" for i in range(R)]
+    for eng in (a, b):
+        for d in docs:
+            eng.connect(d, 1)
+    rows = np.array([a.doc_row(d) for d in docs], np.int32)
+    return a, b, docs, rows
+
+
+def _batches(R, O, n_batches):
+    """(kind, a0, a1) per batch from the typing-storm generator, plus the
+    per-doc client_seq planes continuing across batches."""
+    out = []
+    seq = 1
+    for bi in range(n_batches):
+        planes, seq = typing_storm(R, O, seed=bi, start_seq=seq)
+        cseq = np.broadcast_to(
+            np.arange(bi * O + 1, (bi + 1) * O + 1, dtype=np.int32), (R, O))
+        out.append((planes["kind"], planes["a0"], planes["a1"], cseq))
+    return out
+
+
+def test_columnar_matches_per_op_engine():
+    R, O = 8, 16
+    a, b, docs, rows = _engines(R, O)
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    for kind, a0, a1, cseq in _batches(R, O, 3):
+        res = a.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+        assert res["nacked"] == 0
+        assert (res["seq"] > 0).all()
+        for d in range(R):  # same ops through the per-op submit path
+            for o in range(O):
+                if kind[d, o] == OpKind.STR_INSERT:
+                    contents = {"mt": "insert", "kind": 0,
+                                "pos": int(a0[d, o]), "text": TEXT}
+                else:
+                    contents = {"mt": "remove", "start": int(a0[d, o]),
+                                "end": int(a1[d, o])}
+                msg, nack = b.submit(docs[d], 1, int(cseq[d, o]), 0, contents)
+                assert nack is None
+    for d in docs:
+        assert a.read_text(d) == b.read_text(d), d
+    # C++ and Python sequencers stamped identical seqs
+    for d in docs:
+        assert a.deli.doc_seq(d) == b.deli.doc_seq(d)
+
+
+def test_columnar_nacks_are_skipped_everywhere():
+    R, O = 4, 8
+    a, _, docs, rows = _engines(R, O)
+    (kind, a0, a1, cseq), = _batches(R, O, 1)
+    cseq = cseq.copy()
+    cseq[2, 5] = 99  # clientSeq gap mid-batch for doc 2
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    res = a.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    # the gap cascades: ops 5, 6, 7 of doc 2 all nack (expected cseq stays 6)
+    assert res["nacked"] == 3
+    assert (res["seq"][2, 5:] < 0).all()
+    assert (res["seq"][:2] > 0).all() and (res["seq"][3] > 0).all()
+    # nacked ops are in no log record
+    logged = 0
+    for p in range(a.log.n_partitions):
+        for rec in a.log.read(p):
+            if isinstance(rec, ColumnarOps):
+                assert (rec.seq > 0).all()
+                logged += len(rec.seq)
+    assert logged == R * O - 3
+
+
+def test_columnar_recovery_through_log_replay():
+    R, O = 8, 16
+    a, _, docs, rows = _engines(R, O)
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    batches = _batches(R, O, 3)
+    kind, a0, a1, cseq = batches[0]
+    a.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    summary = a.summarize()
+    for kind, a0, a1, cseq in batches[1:]:
+        a.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    want = {d: a.read_text(d) for d in docs}
+
+    restored = StringServingEngine.load(summary, a.log)
+    for d in docs:
+        assert restored.read_text(d) == want[d], d
+    # sequencing resumes correctly after recovery (native checkpoint blob)
+    msg, nack = restored.submit(
+        docs[0], 1, 3 * O + 1, 0,
+        {"mt": "insert", "kind": 0, "pos": 0, "text": "Z"})
+    assert nack is None
+    assert msg.seq == a.deli.doc_seq(docs[0]) + 1
+    assert restored.read_text(docs[0]) == "Z" + want[docs[0]]
+
+
+def test_columnar_then_per_op_interleave():
+    """Per-op submits after columnar batches continue the same seq space."""
+    R, O = 8, 8
+    a, _, docs, rows = _engines(R, O)
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    (kind, a0, a1, cseq), = _batches(R, O, 1)
+    a.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    before = a.read_text(docs[3])
+    msg, nack = a.submit(docs[3], 1, O + 1, 0,
+                         {"mt": "insert", "kind": 0, "pos": 0, "text": "XY"})
+    assert nack is None
+    assert a.read_text(docs[3]) == "XY" + before
+
+
+def test_columnar_requires_native_sequencer():
+    eng = StringServingEngine(n_docs=8, capacity=256)  # python deli
+    with pytest.raises(RuntimeError, match="native"):
+        eng.ingest_planes(np.arange(8, dtype=np.int32),
+                          np.ones((8, 4), np.int32),
+                          np.ones((8, 4), np.int32),
+                          np.zeros((8, 4), np.int32),
+                          np.zeros((8, 4), np.int32),
+                          np.zeros((8, 4), np.int32),
+                          np.zeros((8, 4), np.int32), TEXT)
+
+
+def test_native_adapter_full_parity_with_python_deli():
+    """Join/leave/sequence/noop/nack parity, op by op, on a multi-client
+    interleaving."""
+    import random
+    from fluidframework_tpu.core.protocol import MessageType
+    from fluidframework_tpu.server.serving import make_sequencer
+    py = make_sequencer("python")
+    nat = make_sequencer("native")
+    assert type(nat).__name__ == "NativeDeliAdapter"
+    rng = random.Random(7)
+    cseq = {}
+    for c in (1, 2, 3):
+        m1, m2 = py.client_join("d", c), nat.client_join("d", c)
+        assert (m1.seq, m1.min_seq) == (m2.seq, m2.min_seq)
+        cseq[c] = 0
+    for i in range(200):
+        c = rng.choice([1, 2, 3])
+        if rng.random() < 0.1:
+            t, cs = MessageType.NOOP, 0
+        else:
+            t = MessageType.OP
+            cseq[c] += 1
+            cs = cseq[c] + (5 if rng.random() < 0.05 else 0)  # rare gap
+        ref = rng.randint(0, max(py.doc_seq("d"), 0))
+        m1, n1 = py.sequence("d", c, cs, ref, t, {"i": i})
+        m2, n2 = nat.sequence("d", c, cs, ref, t, {"i": i})
+        assert (m1 is None) == (m2 is None)
+        if m1 is None:
+            assert n1.reason == n2.reason
+            if t == MessageType.OP:
+                cseq[c] -= 1  # nacked: python-side counter rolls back
+        else:
+            assert (m1.seq, m1.min_seq, m1.ref_seq) == \
+                (m2.seq, m2.min_seq, m2.ref_seq), i
+    m1, m2 = py.client_leave("d", 2), nat.client_leave("d", 2)
+    assert (m1.seq, m1.min_seq) == (m2.seq, m2.min_seq)
+    assert py.client_leave("d", 99) is None
+    assert nat.client_leave("d", 99) is None
+
+
+def test_columnar_replay_clamps_inflated_ref():
+    """An accepted op with an absurd ref_seq is logged CLAMPED; recovery
+    replay must not push the client's ref past doc.seq (which would MSN-
+    nack every later op forever) — code-review r2 finding."""
+    R, O = 8, 8
+    a, _, docs, rows = _engines(R, O)
+    summary0 = a.summarize()  # tail = everything after this
+    client = np.ones((R, O), np.int32)
+    (kind, a0, a1, cseq), = _batches(R, O, 1)
+    ref = np.full((R, O), 10 ** 6, np.int32)  # way past doc.seq
+    res = a.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    assert res["nacked"] == 0
+    restored = StringServingEngine.load(summary0, a.log)
+    for d in docs:
+        assert restored.read_text(d) == a.read_text(d)
+    msg, nack = restored.submit(
+        docs[0], 1, O + 1, restored.deli.doc_seq(docs[0]),
+        {"mt": "insert", "kind": 0, "pos": 0, "text": "ok"})
+    assert nack is None, nack
+
+
+def test_stale_native_handle_nacks_not_crashes():
+    """Handles do not survive restore; a stale one must nack (C++ bounds
+    guard), not dereference garbage."""
+    from fluidframework_tpu.server.native_deli import NativeDeli
+    n = NativeDeli()
+    n.client_join("d", 1)
+    h = n.doc_handle("d")
+    restored = NativeDeli.restore(n.checkpoint())
+    seqs, mins = restored.sequence_batch_rows(
+        np.array([h], np.int32), np.array([1], np.int32),
+        np.array([1], np.int32), np.array([0], np.int32))
+    assert seqs[0] < 0
+
+
+def test_columnar_rejects_duplicate_rows():
+    R, O = 4, 4
+    a, _, docs, rows = _engines(R, O)
+    rows = rows.copy()
+    rows[1] = rows[0]
+    client = np.ones((R, O), np.int32)
+    z = np.zeros((R, O), np.int32)
+    with pytest.raises(ValueError, match="duplicate"):
+        a.ingest_planes(rows, client, client, z, z, z, z, TEXT)
+
+
+def test_columnar_spill_is_lossless(tmp_path):
+    """ColumnarOps in a spill-enabled log must serialize full arrays (the
+    default str() repr elides long ones)."""
+    import json
+    R, O = 8, 130  # > numpy's 1000-element print threshold in one record
+    eng = StringServingEngine(n_docs=R, capacity=1024,
+                              batch_window=10 ** 9, sequencer="native",
+                              log=PartitionedLog(2, spill_dir=str(tmp_path)),
+                              n_partitions=2)
+    docs = [f"doc-{i}" for i in range(R)]
+    for d in docs:
+        eng.connect(d, 1)
+    rows = np.array([eng.doc_row(d) for d in docs], np.int32)
+    kind = np.zeros((R, O), np.int32)  # all inserts
+    a0 = np.zeros((R, O), np.int32)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32), (R, O))
+    eng.ingest_planes(rows, np.ones((R, O), np.int32), cseq,
+                      np.zeros((R, O), np.int32), kind, a0, a0, TEXT)
+    eng.log.close()
+    total_ops = 0
+    for f in tmp_path.iterdir():
+        for line in f.read_text().splitlines():
+            rec = json.loads(line)
+            if isinstance(rec, dict) and rec.get("__type__") == "ColumnarOps":
+                assert "..." not in json.dumps(rec["seq"])
+                total_ops += len(rec["seq"])
+    assert total_ops == R * O
