@@ -152,6 +152,59 @@ class MegaDocStringStore(StringOpInterner):
             at += ln
         raise IndexError(f"doc {doc}: position {pos} beyond length {at}")
 
+    # ------------------------------------------------- overflow recovery
+
+    def adopt_doc(self, row: int, tmp) -> "MegaDocStringStore":
+        """Adopt a rebuilt single-doc flat store's state into mega-doc
+        ``row`` — the re-upload step of the overflow escape hatch: the
+        compacted slots are distributed evenly across shards, payloads and
+        props re-intern into this store's tables, the client map transfers
+        wholesale. Rare path: goes through a full snapshot→modify→restore
+        round trip. Returns the NEW store (caller replaces its reference)."""
+        from ..core.constants import NOT_REMOVED
+        n = int(np.asarray(tmp.state.count[0]))
+        n_shards = self.mesh.devices.size
+        S = self.capacity_per_shard
+        if n > n_shards * S:
+            raise ValueError(
+                f"rebuilt doc needs {n} slots > mega capacity "
+                f"{n_shards}×{S}; graduate it instead")
+        # intern into self's tables FIRST, then snapshot (captures them)
+        hop = self.remap_payload_handles(
+            tmp, np.asarray(tmp.state.handle_op[0][:n]))
+        prop = np.zeros((n_shards * S, self.n_props), np.int32)
+        if tmp._has_props:
+            self._has_props = True
+            self.remap_props(tmp, np.asarray(tmp.state.prop_val[0][:n]),
+                             prop)
+        self._client_idx[row] = dict(tmp._client_idx[0])
+        snap = self.snapshot()
+
+        flat = {k: np.asarray(getattr(tmp.state, k)[0][:n])
+                for k in ("seq", "client", "removed_seq", "removers",
+                          "length", "handle_off")}
+        flat["handle_op"] = hop
+        quota = -(-n // n_shards)  # even spread (ceil)
+        counts = np.zeros(n_shards, np.int32)
+        for k, arr in snap["planes"].items():
+            if k == "prop_val":
+                continue
+            fill = NOT_REMOVED if k == "removed_seq" else 0
+            rowvals = np.full(n_shards * S, fill, np.int32)
+            for s in range(n_shards):
+                chunk = flat[k][s * quota:(s + 1) * quota]
+                rowvals[s * S:s * S + len(chunk)] = chunk
+                counts[s] = len(chunk)
+            arr[row] = rowvals
+        pv = snap["planes"]["prop_val"]
+        pv[row] = 0
+        for s in range(n_shards):
+            chunk = prop[s * quota:(s + 1) * quota]
+            pv[row, s * S:s * S + len(chunk), :chunk.shape[1]] = chunk
+        snap["count"][row] = counts
+        snap["overflow"][row] = 0
+        return MegaDocStringStore.restore(snap, mesh=self.mesh)
+
     def overflowed(self) -> np.ndarray:
         return np.asarray(self.state.overflow)
 

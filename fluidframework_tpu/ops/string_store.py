@@ -22,7 +22,7 @@ import numpy as np
 
 from ..core.constants import NOT_REMOVED
 from .merge_tree_kernel import (
-    MAX_CLIENTS, PROP_HANDLE_BITS, StringState, apply_string_batch,
+    MAX_CLIENTS, PROP_HANDLE_BITS, StringState, _PLANES, apply_string_batch,
     apply_string_batch_jit, compact_string_state_jit, string_state_digest,
 )
 from .pallas_string_kernel import apply_string_batch_pallas
@@ -30,6 +30,25 @@ from .schema import OpKind, ValueInterner
 
 _TEXT = 0
 _MARKER = 1
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _write_row_jit(state, row, seq, client, removed_seq, removers, length,
+                   handle_op, handle_off, prop_val, count):
+    """Overwrite one doc row's planes in a single dispatch (overflow
+    recovery re-upload); clears the row's sticky overflow flag."""
+    return StringState(
+        seq=state.seq.at[row].set(seq),
+        client=state.client.at[row].set(client),
+        removed_seq=state.removed_seq.at[row].set(removed_seq),
+        removers=state.removers.at[row].set(removers),
+        length=state.length.at[row].set(length),
+        handle_op=state.handle_op.at[row].set(handle_op),
+        handle_off=state.handle_off.at[row].set(handle_off),
+        prop_val=state.prop_val.at[row].set(prop_val),
+        count=state.count.at[row].set(count),
+        overflow=state.overflow.at[row].set(0),
+    )
 
 
 @jax.jit
@@ -163,6 +182,35 @@ class StringOpInterner:
         if h >= (1 << PROP_HANDLE_BITS):
             raise OverflowError("property value table exceeded 2^20 entries")
         return h
+
+    def remap_payload_handles(self, src: "StringOpInterner",
+                              handles: np.ndarray) -> np.ndarray:
+        """Re-intern ``src``'s payloads referenced by ``handles`` into THIS
+        store's table; returns the remapped handle array (dedup per distinct
+        source handle). Used by the overflow-recovery re-upload."""
+        hmap: Dict[int, int] = {}
+        out = np.empty_like(handles)
+        for i, h in enumerate(handles):
+            h = int(h)
+            if h not in hmap:
+                kind, text = src._payloads[h]
+                hmap[h] = self._payload(kind, text)
+            out[i] = hmap[h]
+        return out
+
+    def remap_props(self, src: "StringOpInterner", tprop: np.ndarray,
+                    out: np.ndarray) -> None:
+        """Remap ``src``'s (n, K_src) per-slot property-value handles into
+        ``out`` (n+, K_self) under THIS store's key planes and value table
+        (overflow-recovery re-upload)."""
+        n = tprop.shape[0]
+        for key, tplane in src._prop_planes.items():
+            mplane = self._prop_plane(key)
+            col = tprop[:, tplane]
+            vmap = {int(h): (0 if h == 0 else self._prop_values.handle(
+                src._prop_values.value(int(h))))
+                    for h in np.unique(col)}
+            out[:n, mplane] = [vmap[int(h)] for h in col]
 
     def reserve_props(self, props: dict) -> list:
         """Admission-time reservation of the interner capacity ``props``
@@ -664,6 +712,57 @@ class TensorStringStore(StringOpInterner):
                             anchor = slide(i)
                     new.append(anchor)
                 self._intervals[doc][iid] = (new[0], new[1], props)
+
+    # ------------------------------------------------- overflow recovery
+
+    def adopt_doc(self, row: int, tmp: "TensorStringStore") -> None:
+        """Adopt ``tmp``'s single-doc rebuilt state into ``row`` — the
+        re-upload step of the overflow escape hatch (SURVEY.md §7 risk (b)):
+        payload handles re-intern into this store's table, the per-doc
+        client map transfers wholesale (client indexes are doc-local, so
+        client/removers planes carry over bit-exact), property planes remap
+        by key, and the row's device planes are overwritten in one jitted
+        update that also clears the sticky overflow flag. ``tmp`` must fit:
+        count ≤ capacity and no overflow."""
+        n = int(np.asarray(tmp.state.count[0]))
+        assert n <= self.capacity and not tmp.overflowed().any()
+        planes = {k: np.asarray(getattr(tmp.state, k)[0][:n]).copy()
+                  for k in _PLANES}
+        planes["handle_op"] = self.remap_payload_handles(
+            tmp, planes["handle_op"])
+        self._client_idx[row] = dict(tmp._client_idx[0])
+
+        prop = np.zeros((self.capacity, self.n_props), np.int32)
+        if tmp._has_props:
+            self._has_props = True
+            self.remap_props(tmp, np.asarray(tmp.state.prop_val[0][:n]),
+                             prop)
+
+        def pad(a, fill=0):
+            out = np.full((self.capacity,) + a.shape[1:], fill, np.int32)
+            out[:n] = a
+            return out
+
+        self.state = _write_row_jit(
+            self.state, jnp.int32(row),
+            *(jnp.asarray(pad(planes[k],
+                              NOT_REMOVED if k == "removed_seq" else 0))
+              for k in _PLANES),
+            jnp.asarray(prop), jnp.int32(n))
+        # interval bookkeeping restarts from the rebuilt planes
+        if self._intervals[row]:
+            self._seed_tombs(row)
+
+    def clear_doc(self, row: int) -> None:
+        """Empty a row (used when a doc graduates off this store): planes
+        zero, overflow flag cleared."""
+        z = np.zeros((self.capacity,), np.int32)
+        self.state = _write_row_jit(
+            self.state, jnp.int32(row),
+            *(jnp.asarray(np.full_like(z, NOT_REMOVED)
+                          if k == "removed_seq" else z) for k in _PLANES),
+            jnp.asarray(np.zeros((self.capacity, self.n_props), np.int32)),
+            jnp.int32(0))
 
     def overflowed(self) -> np.ndarray:
         return np.asarray(self.state.overflow)

@@ -115,6 +115,10 @@ class ServingEngineBase:
         self.batch_window = batch_window
         self.compact_every = compact_every
         self._doc_rows: Dict[str, int] = {}
+        # row allocator: freed rows (docs that graduated off this tier) are
+        # reused before fresh ones
+        self._free_rows: List[int] = []
+        self._next_row = 0
         self._queue: List[Tuple[int, SequencedDocumentMessage]] = []
         self._flushes_since_compact = 0
         self._min_seq: Dict[str, int] = {}
@@ -144,9 +148,14 @@ class ServingEngineBase:
 
     def doc_row(self, doc_id: str) -> int:
         if doc_id not in self._doc_rows:
-            if len(self._doc_rows) >= self.n_docs:
+            if self._free_rows:
+                row = self._free_rows.pop()
+            elif self._next_row < self.n_docs:
+                row = self._next_row
+                self._next_row += 1
+            else:
                 raise KeyError(f"document capacity {self.n_docs} exhausted")
-            self._doc_rows[doc_id] = len(self._doc_rows)
+            self._doc_rows[doc_id] = row
         return self._doc_rows[doc_id]
 
     def connect(self, doc_id: str, client_id: int
@@ -280,6 +289,10 @@ class ServingEngineBase:
         self.deli = restore_sequencer(summary["deli"],
                                       clock=self.deli.clock)
         self._doc_rows = dict(summary["doc_rows"])
+        used = set(self._doc_rows.values())
+        self._next_row = max(used) + 1 if used else 0
+        self._free_rows = [r for r in range(self._next_row)
+                           if r not in used]
         self._min_seq = dict(summary["min_seq"])
         if summary.get("attribution") is not None:
             self._attributors = {d: Attributor.load(a)
@@ -336,7 +349,16 @@ class StringServingEngine(ServingEngineBase):
                                                  mega_capacity_per_shard)
         self.n_docs = n_docs
         self._mega_rows: Dict[str, int] = {}
+        self._free_mega_rows: List[int] = []
         self._mega_queue: List[Tuple[int, SequencedDocumentMessage]] = []
+        # graduated tier: docs whose compacted state outgrew their tier's
+        # slot budget are served from their own right-sized store (the
+        # terminal stage of the overflow escape hatch)
+        self._graduated: Dict[str, TensorStringStore] = {}
+        self._grad_queue: List[Tuple[str, SequencedDocumentMessage]] = []
+        #: overflow flags are checked (one device→host read) and recovery
+        #: runs automatically on the compaction cadence
+        self.auto_recover = True
 
     # ------------------------------------------------------------ membership
 
@@ -366,9 +388,13 @@ class StringServingEngine(ServingEngineBase):
                 contents={"markMega": True}))
 
     def _register_mega(self, doc_id: str) -> None:
-        if len(self._mega_rows) >= self.mega_store.n_docs:
+        if self._free_mega_rows:
+            self._mega_rows[doc_id] = self._free_mega_rows.pop()
+            return
+        nxt = len(self._mega_rows) + len(self._free_mega_rows)
+        if nxt >= self.mega_store.n_docs:
             raise KeyError("mega-doc capacity exhausted")
-        self._mega_rows[doc_id] = len(self._mega_rows)
+        self._mega_rows[doc_id] = nxt
 
     # --------------------------------------------------------------- ingress
 
@@ -420,7 +446,8 @@ class StringServingEngine(ServingEngineBase):
         before the op is logged): an annotate whose key cannot get a plane
         would otherwise raise at flush. The reservation is transactional —
         ``_unadmit`` refunds it if the sequencer nacks afterwards."""
-        self.doc_row(doc_id)
+        if doc_id not in self._graduated:  # graduated docs own their store;
+            self.doc_row(doc_id)           # don't re-pin a tier row
         self._admit_token = None
         props = contents.get("props")
         if props:
@@ -434,6 +461,9 @@ class StringServingEngine(ServingEngineBase):
         self._admit_token = None
 
     def _enqueue(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
+        if doc_id in self._graduated:
+            self._grad_queue.append((doc_id, msg))
+            return
         row = self.doc_row(doc_id)
         if doc_id in self._mega_rows:
             self._mega_queue.append((row, msg))
@@ -441,7 +471,8 @@ class StringServingEngine(ServingEngineBase):
             self._queue.append((row, msg))
 
     def _queued(self) -> int:
-        return len(self._queue) + len(self._mega_queue)
+        return len(self._queue) + len(self._mega_queue) + \
+            len(self._grad_queue)
 
     def heartbeat(self, doc_id: str, client_id: int, ref_seq: int) -> None:
         """NOOP: advances the client's refSeq (and the doc's MSN) so zamboni
@@ -455,7 +486,8 @@ class StringServingEngine(ServingEngineBase):
             # Only docs that already hold a row can have intervals — looking
             # one up via _store_of would lazily allocate a flat-tier row and
             # wrongly pin a heartbeat-only doc (breaking a later mark_mega).
-            if doc_id in self._doc_rows or doc_id in self._mega_rows:
+            if doc_id in self._doc_rows or doc_id in self._mega_rows \
+                    or doc_id in self._graduated:
                 store, row = self._store_of(doc_id)
                 if getattr(store, "_intervals", None) \
                         and store._intervals[row]:
@@ -489,6 +521,10 @@ class StringServingEngine(ServingEngineBase):
         if len(np.unique(rows)) != R:
             raise ValueError("duplicate rows in columnar batch (the device "
                              "scatter would silently drop ops)")
+        if self._graduated and any(self._row_doc_id[r] in self._graduated
+                                   for r in rows):
+            raise ValueError("a targeted doc has graduated off the flat "
+                             "tier; route its ops through submit()")
         kind = np.asarray(kind, np.int32)
         if not np.isin(kind, (int(OpKind.STR_INSERT),
                               int(OpKind.STR_REMOVE))).all():
@@ -579,6 +615,10 @@ class StringServingEngine(ServingEngineBase):
                 for doc_id, row in self._mega_rows.items():
                     mms[row] = self._min_seq.get(doc_id, 0)
                 self.mega_store.compact(mms)
+            for doc_id, store in self._graduated.items():
+                store.compact(self._min_seq.get(doc_id, 0))
+            if self.auto_recover:  # same contract as compact(): recovery
+                self.recover_overflowed()  # runs on the compaction cadence
         else:
             self._flushes_since_compact += 1
         return {"seq": seq_rs, "nacked": int(nacked.sum())}
@@ -594,10 +634,18 @@ class StringServingEngine(ServingEngineBase):
         if self._mega_queue:
             self.mega_store.apply_messages(self._mega_queue)
             self._mega_queue.clear()
+        if self._grad_queue:
+            per_doc: Dict[str, list] = {}
+            for doc_id, msg in self._grad_queue:
+                per_doc.setdefault(doc_id, []).append((0, msg))
+            for doc_id, msgs in per_doc.items():
+                self._graduated[doc_id].apply_messages(msgs)
+            self._grad_queue.clear()
         return n
 
     def compact(self) -> None:
-        """Zamboni at each doc's MSN (collaboration-window floor)."""
+        """Zamboni at each doc's MSN (collaboration-window floor); checks
+        overflow flags and runs recovery on the same cadence."""
         min_seq = np.zeros((self.n_docs,), np.int32)
         for doc_id, row in self._doc_rows.items():
             min_seq[row] = self._min_seq.get(doc_id, 0)
@@ -607,11 +655,17 @@ class StringServingEngine(ServingEngineBase):
             for doc_id, row in self._mega_rows.items():
                 ms[row] = self._min_seq.get(doc_id, 0)
             self.mega_store.compact(ms)
+        for doc_id, store in self._graduated.items():
+            store.compact(self._min_seq.get(doc_id, 0))
         super().compact()
+        if self.auto_recover:
+            self.recover_overflowed()
 
     # ----------------------------------------------------------------- reads
 
     def _store_of(self, doc_id: str):
+        if doc_id in self._graduated:
+            return self._graduated[doc_id], 0
         if doc_id in self._mega_rows:
             return self.mega_store, self._mega_rows[doc_id]
         return self.store, self.doc_row(doc_id)
@@ -638,7 +692,7 @@ class StringServingEngine(ServingEngineBase):
     def overflowed_docs(self) -> List[str]:
         """Docs whose device capacity overflowed (ops dropped): these must
         be drained through the oracle and re-uploaded (the escape hatch of
-        SURVEY.md §7 risk (b))."""
+        SURVEY.md §7 risk (b)); ``recover_overflowed`` does exactly that."""
         flags = self.store.overflowed()
         out = [d for d, row in self._doc_rows.items() if flags[row]]
         if self.mega_store is not None and self._mega_rows:
@@ -646,6 +700,138 @@ class StringServingEngine(ServingEngineBase):
             out += [d for d, row in self._mega_rows.items()
                     if mflags[row].any()]
         return out
+
+    # ----------------------------------------------------- overflow recovery
+
+    def recover_overflowed(self, grow_limit: int = 1 << 20) -> Dict[str, str]:
+        """The overflow escape hatch, end to end (SURVEY.md §7 risk (b)):
+        for every doc whose device row overflowed (the kernel dropped its
+        later ops, sticky flag set), drain the doc's FULL op history from
+        the durable log through a fresh rebuild at doubled capacity (the
+        same apply kernels — recovery stays one primitive), compact at the
+        doc's window floor, then either re-upload into the original row
+        (fits again) or graduate the doc to its own right-sized store
+        (terminal tier). Zero acked ops are lost: the log has every
+        sequenced op. Returns {doc_id: "reuploaded" | "graduated"}."""
+        self.flush()  # logged-but-queued ops must not double-apply: the
+        # rebuild replays the FULL log, so the queues must be empty
+        report: Dict[str, str] = {}
+        flags = self.store.overflowed()
+        for doc_id in [d for d, r in self._doc_rows.items() if flags[r]]:
+            report[doc_id] = self._recover_flat(doc_id, grow_limit)
+        if self.mega_store is not None and self._mega_rows:
+            mflags = self.mega_store.overflowed()
+            for doc_id in [d for d, r in self._mega_rows.items()
+                           if mflags[r].any()]:
+                report[doc_id] = self._recover_mega(doc_id, grow_limit)
+        # the terminal tier can overflow too (doc kept growing past its
+        # rebuild-time capacity): rebuild in place at doubled capacity
+        for doc_id, store in list(self._graduated.items()):
+            if store.overflowed().any():
+                tmp = self._rebuild_doc(doc_id, store.capacity, grow_limit,
+                                        store.n_props)
+                ivs = store.intervals(0) if store._intervals[0] else {}
+                self._graduated[doc_id] = tmp
+                self._readd_intervals(tmp, 0, ivs)
+                report[doc_id] = "regrown"
+        if report:
+            self.metrics.inc("overflow_recoveries", len(report))
+        return report
+
+    def _doc_log_messages(self, doc_id: str):
+        """Every sequenced OP message for one doc, seq-ascending, from the
+        durable log (ColumnarOps records expand; a doc lives entirely in
+        one partition, so the log holds its full history in order)."""
+        p = partition_of(doc_id, self.log.n_partitions)
+        msgs = []
+        for rec in self.log.read(p):
+            if isinstance(rec, ColumnarOps):
+                if doc_id in rec.doc_ids:
+                    msgs.extend(m for m in rec.expand()
+                                if m.doc_id == doc_id)
+            elif rec.doc_id == doc_id and rec.type == MessageType.OP:
+                msgs.append(rec)
+        msgs.sort(key=lambda m: m.seq)
+        return msgs
+
+    def _rebuild_doc(self, doc_id: str, start_capacity: int,
+                     grow_limit: int,
+                     n_props: Optional[int] = None) -> TensorStringStore:
+        """Replay a doc's full log history into a fresh single-doc store,
+        doubling capacity until it fits, compacted at the window floor.
+        ``n_props`` must be the OWNING tier's plane count (tiers differ)."""
+        msgs = self._doc_log_messages(doc_id)
+        cap = max(start_capacity, 128)
+        props = n_props if n_props is not None else self.store.n_props
+        while True:
+            cap *= 2
+            if cap > grow_limit:
+                raise MemoryError(
+                    f"{doc_id}: rebuild exceeds grow limit {grow_limit}")
+            tmp = TensorStringStore(1, cap, props)
+            tmp.apply_messages((0, m) for m in msgs)
+            if not tmp.overflowed().any():
+                break
+        tmp.compact(self._min_seq.get(doc_id, 0))
+        return tmp
+
+    def _recover_flat(self, doc_id: str, grow_limit: int) -> str:
+        row = self._doc_rows[doc_id]
+        tmp = self._rebuild_doc(doc_id, self.store.capacity, grow_limit)
+        # intervals: anchors reference pre-rebuild payload handles; re-derive
+        # them at the same visible positions in the rebuilt text (the best
+        # information an overflowed row can offer)
+        ivs = self.store.intervals(row) if self.store._intervals[row] else {}
+        if int(np.asarray(tmp.state.count[0])) <= self.store.capacity:
+            self.store.adopt_doc(row, tmp)
+            self._readd_intervals(self.store, row, ivs)
+            return "reuploaded"
+        self.store._intervals[row] = {}
+        self.store.clear_doc(row)
+        self._graduated[doc_id] = tmp
+        self._readd_intervals(tmp, 0, ivs)
+        self._release_flat_row(doc_id)
+        return "graduated"
+
+    def _release_flat_row(self, doc_id: str) -> None:
+        """Return a graduated doc's flat row to the allocator (and clear
+        the columnar caches so a reused row can't hit a stale handle)."""
+        row = self._doc_rows.pop(doc_id)
+        self._free_rows.append(row)
+        self._row_doc_id[row] = None
+        self._row_handle[row] = -1
+
+    @staticmethod
+    def _readd_intervals(store, row: int, ivs: dict) -> None:
+        vis = store.visible_length(row)
+        for iid, (start, end, props) in ivs.items():
+            clamp = lambda p: max(0, min(int(p), max(vis - 1, 0)))
+            store._intervals[row][iid] = (
+                store._anchor_at(row, clamp(start)),
+                store._anchor_at(row, clamp(end)), dict(props))
+        if ivs:
+            store._seed_tombs(row)
+
+    def _recover_mega(self, doc_id: str, grow_limit: int) -> str:
+        row = self._mega_rows[doc_id]
+        tmp = self._rebuild_doc(
+            doc_id, self.mega_store.capacity_per_shard, grow_limit,
+            self.mega_store.n_props)
+        n = int(np.asarray(tmp.state.count[0]))
+        mega_cap = self.mega_store.capacity_per_shard * \
+            self.mega_store.mesh.devices.size
+        if n <= mega_cap:
+            self.mega_store = self.mega_store.adopt_doc(row, tmp)
+            return "reuploaded"
+        # too big even for the sharded tier: graduate; adopting an empty
+        # rebuild clears the mega row (and its sticky overflow flag), and
+        # the row returns to the mega allocator
+        self._graduated[doc_id] = tmp
+        self.mega_store = self.mega_store.adopt_doc(
+            row, TensorStringStore(1, 128, self.mega_store.n_props))
+        del self._mega_rows[doc_id]
+        self._free_mega_rows.append(row)
+        return "graduated"
 
     # ----------------------------------------------------- summary / recovery
 
@@ -659,6 +845,8 @@ class StringServingEngine(ServingEngineBase):
         summary["mega_store"] = self.mega_store.snapshot() \
             if self.mega_store is not None else None
         summary["mega_rows"] = dict(self._mega_rows)
+        summary["graduated"] = {d: s.snapshot()
+                                for d, s in self._graduated.items()}
         return summary
 
     @classmethod
@@ -676,6 +864,9 @@ class StringServingEngine(ServingEngineBase):
                      log=log, store=store, mega_store=mega, **kwargs)
         engine._restore_base(summary)
         engine._mega_rows = dict(summary.get("mega_rows", {}))
+        engine._graduated = {
+            d: TensorStringStore.restore(s)
+            for d, s in summary.get("graduated", {}).items()}
 
         def mark_mega_hook(msg):
             if msg.type == MessageType.PROPOSAL and \
@@ -688,6 +879,7 @@ class StringServingEngine(ServingEngineBase):
 
         engine._replay_tail(summary, control_hook=mark_mega_hook)
         engine._mega_queue.sort(key=lambda dm: dm[1].seq)
+        engine._grad_queue.sort(key=lambda dm: dm[1].seq)
         engine.flush()
         return engine
 
