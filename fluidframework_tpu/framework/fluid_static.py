@@ -101,6 +101,23 @@ class FluidContainer:
     def connect(self) -> None:
         self._container.connect()
 
+    def pump(self, timeout: float = 0.0) -> int:
+        """Dispatch queued inbound frames on this thread (network driver in
+        auto_pump=False mode; no-op for synchronous drivers)."""
+        conn = self._container.delta_manager.connection
+        if conn is not None and hasattr(conn, "pump"):
+            return conn.pump(timeout)
+        return 0
+
+    def pump_until(self, predicate, timeout: float = 10.0) -> None:
+        """Pump until ``predicate()`` is true (raises TimeoutError)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while not predicate():
+            if _time.monotonic() > deadline:
+                raise TimeoutError("pump_until condition not reached")
+            self.pump(timeout=0.05)
+
     def dispose(self) -> None:
         self._container.close()
 
@@ -151,3 +168,17 @@ class LocalClient(ServiceClient):
         factory = LocalDocumentServiceFactory(service)
         super().__init__(factory, **kwargs)
         self.service = factory.service
+
+
+class NetworkClient(ServiceClient):
+    """The full client stack against a REAL localhost ordering service
+    (``server.ingress`` — the Alfred analog): every op crosses a process
+    boundary. ``auto_pump=False`` (default) keeps the container
+    single-threaded — drive inbound with ``FluidContainer.pump()``."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7070,
+                 auto_pump: bool = False, **kwargs):
+        from ..drivers.network_driver import NetworkDocumentServiceFactory
+        super().__init__(
+            NetworkDocumentServiceFactory(host, port, auto_pump=auto_pump),
+            **kwargs)

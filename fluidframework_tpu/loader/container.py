@@ -47,6 +47,9 @@ class Container:
         self.runtime: Any = None
         self._runtime_factory = runtime_factory
         self._listeners: Dict[str, List[Callable]] = {}
+        # every client id this container has held across reconnects (the
+        # "is this op mine" set — see _process)
+        self._my_client_ids: set = set()
 
     # -------------------------------------------------------------- listeners
 
@@ -114,6 +117,7 @@ class Container:
         return self.protocol.quorum
 
     def _on_connected(self, client_id: int) -> None:
+        self._my_client_ids.add(client_id)
         if self.runtime is not None and \
                 hasattr(self.runtime, "set_connection_state"):
             self.runtime.set_connection_state(True, client_id)
@@ -130,8 +134,14 @@ class Container:
     def _process(self, msg: SequencedDocumentMessage) -> None:
         self.protocol.process(msg)
         if msg.type in _RUNTIME_TYPES and self.runtime is not None:
-            local = (self.delta_manager.client_id is not None
-                     and msg.client_id == self.delta_manager.client_id)
+            # "local" = submitted by THIS container on ANY of its
+            # connections: after a reconnect, catch-up echoes of ops
+            # submitted under the PREVIOUS client id must still ack the
+            # pending records — judging by the current id alone would
+            # resubmit already-sequenced ops and duplicate them for every
+            # client (found by the network-driver e2e drill; the local
+            # driver's synchronous acks never expose the race)
+            local = msg.client_id in self._my_client_ids
             self.runtime.process(msg, local)
         self._emit("op", msg)
 

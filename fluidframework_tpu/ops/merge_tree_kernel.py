@@ -102,6 +102,18 @@ def _active(s, S):
     return _iota(S) < s["count"]
 
 
+def _prop_keys(s):
+    """The per-key property planes of a state dict, in key-plane order.
+
+    Properties live as K separate 2-D (S,) / (D, S) planes named
+    ``prop0..propK-1`` — NOT one (S, K) array: a tiny minor dim gets
+    lane-padded to 128 in TPU vector layouts, which both bloats VMEM ~32×
+    and blocks Mosaic's i1 reshapes. The XLA entry points split/restack
+    the state's (D, S, K) ``prop_val`` at the boundary."""
+    return tuple(f"prop{i}" for i in range(len(s))
+                 if f"prop{i}" in s)
+
+
 def _visible(s, ref_seq, client_idx):
     S = s["seq"].shape[0]
     ins = (s["seq"] <= ref_seq) | (s["client"] == client_idx)
@@ -191,23 +203,34 @@ def _insert_one(s, pos, length, handle, seq, client_idx, ref_seq,
     out["removed_seq"] = jnp.where(is_new, NOT_REMOVED, out["removed_seq"])
     out["removers"] = jnp.where(is_new, 0, out["removers"])
 
-    # property planes (S, K): same roll, split right piece inherits the
-    # containing slot's props via roll-by-2; new segments carry none (host
-    # inserts-with-props are expressed as insert + annotate at one seq).
-    # with_props=False (host knows no annotate ever touched this store):
-    # all-zero planes are permutation-invariant, skip the movement — this
-    # is ~35% of the kernel's HBM traffic.
+    # property planes (one (S,) plane per key): same roll, split right
+    # piece inherits the containing slot's props via roll-by-2; new
+    # segments carry none (host inserts-with-props are expressed as insert
+    # + annotate at one seq). with_props=False (host knows no annotate
+    # ever touched this store): all-zero planes are permutation-invariant,
+    # skip the movement — ~35% of the kernel's HBM traffic.
+    data_keys = _PLANES
     if with_props:
-        pshift = jnp.where(has_inside, jnp.roll(s["prop_val"], 2, axis=0),
-                           jnp.roll(s["prop_val"], 1, axis=0))
-        pv = jnp.where(below[:, None], s["prop_val"], pshift)
-        out["prop_val"] = jnp.where(is_new[:, None], 0, pv)
-    else:
+        pkeys = _prop_keys(s)
+        data_keys = _PLANES + pkeys
+        for pk in pkeys:
+            pshift = jnp.where(has_inside, jnp.roll(s[pk], 2),
+                               jnp.roll(s[pk], 1))
+            pv = jnp.where(below, s[pk], pshift)
+            out[pk] = jnp.where(is_new, 0, pv)
+        if "prop_val" in s:  # stacked (S, K) variant (megadoc XLA path)
+            data_keys = data_keys + ("prop_val",)
+            pshift3 = jnp.where(has_inside,
+                                jnp.roll(s["prop_val"], 2, axis=0),
+                                jnp.roll(s["prop_val"], 1, axis=0))
+            pv3 = jnp.where(below[:, None], s["prop_val"], pshift3)
+            out["prop_val"] = jnp.where(is_new[:, None], 0, pv3)
+    elif "prop_val" in s:
         out["prop_val"] = s["prop_val"]
+        data_keys = _PLANES + ("prop_val",)
 
     # overflow: leave the doc untouched, set the sticky flag
-    res = {k: jnp.where(would_overflow, s[k], out[k])
-           for k in _PLANES + ("prop_val",)}
+    res = {k: jnp.where(would_overflow, s[k], out[k]) for k in data_keys}
     res["count"] = jnp.where(would_overflow, s["count"], new_count)
     res["overflow"] = jnp.where(would_overflow, 1, s["overflow"])
     return res
@@ -239,11 +262,22 @@ def _split_at(s, p, ref_seq, client_idx, with_props=True):
         jnp.where(is_right, out["length"] - off, out["length"]))
     out["handle_off"] = jnp.where(
         is_right, out["handle_off"] + off, out["handle_off"])
-    out["prop_val"] = jnp.where((i <= j)[:, None], s["prop_val"],
-                                jnp.roll(s["prop_val"], 1, axis=0)) \
-        if with_props else s["prop_val"]
+    data_keys = _PLANES
+    if with_props:
+        pkeys = _prop_keys(s)
+        data_keys = _PLANES + pkeys
+        for pk in pkeys:
+            out[pk] = jnp.where(i <= j, s[pk], jnp.roll(s[pk], 1))
+        if "prop_val" in s:  # stacked (S, K) variant (megadoc XLA path)
+            data_keys = data_keys + ("prop_val",)
+            out["prop_val"] = jnp.where(
+                (i <= j)[:, None], s["prop_val"],
+                jnp.roll(s["prop_val"], 1, axis=0))
+    elif "prop_val" in s:
+        out["prop_val"] = s["prop_val"]
+        data_keys = _PLANES + ("prop_val",)
 
-    res = {k: jnp.where(do, out[k], s[k]) for k in _PLANES + ("prop_val",)}
+    res = {k: jnp.where(do, out[k], s[k]) for k in data_keys}
     res["count"] = jnp.where(do, new_count, s["count"])
     res["overflow"] = jnp.where(has_inside & would_overflow, 1, s["overflow"])
     return res
@@ -283,12 +317,15 @@ def _range_one(s, kind, start, end_pos, packed, seq, client_idx, ref_seq,
                                 s["removers"])
 
     if with_props:
-        K = s["prop_val"].shape[1]
         key_idx = packed >> PROP_HANDLE_BITS
         handle = packed & ((1 << PROP_HANDLE_BITS) - 1)
-        sel = (target & (kind == OpKind.STR_ANNOTATE))[:, None] & \
-            (jnp.arange(K)[None, :] == key_idx)
-        out["prop_val"] = jnp.where(sel, handle, s["prop_val"])
+        is_ann = target & (kind == OpKind.STR_ANNOTATE)
+        for ki, pk in enumerate(_prop_keys(s)):
+            out[pk] = jnp.where(is_ann & (key_idx == ki), handle, s[pk])
+        if "prop_val" in s:  # stacked (S, K) variant (megadoc XLA path)
+            K = s["prop_val"].shape[1]
+            sel = is_ann[:, None] & (jnp.arange(K)[None, :] == key_idx)
+            out["prop_val"] = jnp.where(sel, handle, s["prop_val"])
     return out
 
 
@@ -322,6 +359,12 @@ def apply_string_batch(state: StringState, kind, a0, a1, a2, seq, client,
     the scan untouched).
     """
     sd = _state_dict(state)
+    K = state.prop_val.shape[2]
+    if with_props:
+        # split (D, S, K) into K 2-D planes for the helpers (see _prop_keys)
+        pv = sd.pop("prop_val")
+        for i in range(K):
+            sd[f"prop{i}"] = pv[:, :, i]
 
     def step(carry, op):
         k, p0, p1, p2, sq, cl, rs = op
@@ -343,6 +386,9 @@ def apply_string_batch(state: StringState, kind, a0, a1, a2, seq, client,
 
     ops = (kind.T, a0.T, a1.T, a2.T, seq.T, client.T, ref_seq.T)  # (O, D)
     out, _ = jax.lax.scan(step, sd, ops)
+    if with_props:
+        out["prop_val"] = jnp.stack(
+            [out.pop(f"prop{i}") for i in range(K)], axis=-1)
     return StringState(**out)
 
 

@@ -9,9 +9,11 @@ O(ops) HBM traffic into O(1) per batch. The per-op math is literally the
 same ``_insert_one`` / ``_range_one`` helpers as the XLA path (vmapped over
 the tile's docs), so semantics are shared by construction, not re-derived.
 
-Serving (no-props) path only: stores that have never seen an annotate
-(``TensorStringStore._has_props`` False, the mode the north-star benchmark
-measures). Property planes thread through untouched host-side.
+Two specializations: no-props (stores that have never seen an annotate —
+``TensorStringStore._has_props`` False, the mode the north-star benchmark
+measures; property planes thread through untouched host-side) and props
+(``with_props=True``: the K property planes ride along in VMEM, so
+annotate-heavy workloads — rich text, config #5 — stay on the fused path).
 
 VMEM budget per tile: 7 planes × T×S int32 + op planes × T×O + live
 temporaries — T=128, S=384 measures fastest on v5e (2.2× the XLA scan at
@@ -36,7 +38,7 @@ _OPS = 7      # kind, a0, a1, a2, seq, client, ref_seq
 _NP = len(_PLANES)
 
 
-def _compact(c, min_seq):
+def _compact(c, min_seq, keys=_PLANES):
     """In-VMEM zamboni: stable stream compaction by bit-decomposed shifts.
 
     Drop slots whose removal is acked at or below min_seq. Each surviving
@@ -45,7 +47,9 @@ def _compact(c, min_seq):
     are at least δ+1 apart, so shifting every slot whose d has bit b by
     2^b (LSB→MSB) never collides. log2(S) roll+select passes, no sort, no
     gather. Vacated slots are zeroed (removed_seq=NOT_REMOVED) — like the
-    XLA sort path, slots at or beyond count are semantically ignored."""
+    XLA sort path, slots at or beyond count are semantically ignored.
+    ``keys`` lists the 2-D (T, S) planes to move (props mode adds the
+    unstacked property planes)."""
     from ..core.constants import NOT_REMOVED
     S = c["seq"].shape[-1]
     active = _iota2(c["seq"].shape) < c["count"][:, None]
@@ -55,7 +59,7 @@ def _compact(c, min_seq):
     d = _excl_cumsum_last(dropped)
 
     occ = keep
-    planes = {k: c[k] for k in _PLANES}
+    planes = {k: c[k] for k in keys}
     idx = _iota2(c["seq"].shape)
     step = 1
     while step < S:
@@ -68,7 +72,7 @@ def _compact(c, min_seq):
         moves_in = (jnp.roll(b_set_i, -step, axis=-1) == 1) & \
             (idx < S - step)
         stays = occ & ~b_set
-        for k in _PLANES:
+        for k in keys:
             incoming = jnp.roll(planes[k], -step, axis=-1)
             planes[k] = jnp.where(moves_in, incoming,
                                   jnp.where(stays, planes[k], 0))
@@ -94,21 +98,32 @@ def _excl_cumsum_last(x):
     return jnp.where(_iota2(x.shape) == 0, 0, jnp.roll(c, 1, axis=-1))
 
 
-def _kernel(*refs, compact: bool):
+def _kernel(*refs, compact: bool, n_props: int):
+    """n_props=0: the no-props specialization (property planes untouched
+    host-side). n_props=K: the K property planes ride along in VMEM as K
+    extra (T, S) refs, moved by the same split/shift/compact passes."""
     if compact:
         ms_ref, refs = refs[0], refs[1:]
+    np_ = _NP + n_props
     op_refs = refs[:_OPS]
-    plane_refs = refs[_OPS:_OPS + _NP]
-    cnt_ref, ovf_ref = refs[_OPS + _NP:_OPS + _NP + 2]
-    out_plane_refs = refs[_OPS + _NP + 2:_OPS + 2 * _NP + 2]
-    out_cnt_ref, out_ovf_ref = refs[_OPS + 2 * _NP + 2:]
+    plane_refs = refs[_OPS:_OPS + np_]
+    cnt_ref, ovf_ref = refs[_OPS + np_:_OPS + np_ + 2]
+    out_plane_refs = refs[_OPS + np_ + 2:_OPS + 2 * np_ + 2]
+    out_cnt_ref, out_ovf_ref = refs[_OPS + 2 * np_ + 2:]
+    with_props = n_props > 0
 
     n_ops = op_refs[0].shape[1]
     ops = tuple(r[:] for r in op_refs)              # each (T, O), VMEM
     lane = jax.lax.broadcasted_iota(jnp.int32, ops[0].shape, 1)
-    carry = dict(zip(_PLANES, (r[:] for r in plane_refs)))
-    # dummy 1-wide prop plane: the with_props=False helpers pass it through
-    carry["prop_val"] = jnp.zeros(carry["seq"].shape + (1,), jnp.int32)
+    carry = dict(zip(_PLANES, (r[:] for r in plane_refs[:_NP])))
+    if with_props:
+        # K separate (T, S) planes — a stacked (T, S, K) would lane-pad
+        # the minor dim to 128 in VMEM (~32× bloat); see _prop_keys
+        for i in range(n_props):
+            carry[f"prop{i}"] = plane_refs[_NP + i][:]
+    else:
+        # dummy 1-wide prop plane: with_props=False helpers pass it through
+        carry["prop_val"] = jnp.zeros(carry["seq"].shape + (1,), jnp.int32)
     carry["count"] = cnt_ref[:, 0]
     carry["overflow"] = ovf_ref[:, 0]
 
@@ -117,9 +132,9 @@ def _kernel(*refs, compact: bool):
         # on values nor unaligned dynamic lane indexing on refs
         take = lambda x: jnp.sum(jnp.where(lane == o, x, 0), axis=1)
         k, p0, p1, p2, sq, cl, rs = (take(x) for x in ops)
-        ins = jax.vmap(functools.partial(_insert_one, with_props=False)
+        ins = jax.vmap(functools.partial(_insert_one, with_props=with_props)
                        )(c, p0, p1, p2, sq, cl, rs)
-        rng = jax.vmap(functools.partial(_range_one, with_props=False)
+        rng = jax.vmap(functools.partial(_range_one, with_props=with_props)
                        )(c, k, p0, p1, p2, sq, cl, rs)
 
         def pick(key):
@@ -133,9 +148,10 @@ def _kernel(*refs, compact: bool):
         return {key: pick(key) for key in c}
 
     out = jax.lax.fori_loop(0, n_ops, body, carry)
+    prop_keys = tuple(f"prop{i}" for i in range(n_props))
     if compact:
-        out = _compact(out, ms_ref[:, 0])
-    for name, ref in zip(_PLANES, out_plane_refs):
+        out = _compact(out, ms_ref[:, 0], keys=_PLANES + prop_keys)
+    for name, ref in zip(_PLANES + prop_keys, out_plane_refs):
         ref[:] = out[name]
     out_cnt_ref[:, 0] = out["count"]
     out_ovf_ref[:, 0] = out["overflow"]
@@ -143,11 +159,17 @@ def _kernel(*refs, compact: bool):
 
 def apply_string_batch_pallas(state: StringState, kind, a0, a1, a2, seq,
                               client, ref_seq, min_seq=None, tile: int = 128,
-                              interpret: bool = False) -> StringState:
-    """Drop-in equivalent of ``apply_string_batch(..., with_props=False)``,
-    optionally fused with zamboni: pass ``min_seq`` (D,) to compact each
-    doc inside the kernel epilogue while the planes are still in VMEM —
-    one dispatch, one HBM round-trip for apply + compact.
+                              interpret: bool = False,
+                              with_props: bool = False) -> StringState:
+    """Drop-in equivalent of ``apply_string_batch``, optionally fused with
+    zamboni: pass ``min_seq`` (D,) to compact each doc inside the kernel
+    epilogue while the planes are still in VMEM — one dispatch, one HBM
+    round-trip for apply + compact.
+
+    ``with_props=False`` is the annotate-free specialization (property
+    planes thread through untouched host-side); ``with_props=True`` loads
+    the K property planes into VMEM alongside the rest, so annotate-bearing
+    stores stay on the fused path too.
 
     D must divide by ``tile``; S should be a multiple of 128 (lane width).
     ``interpret=True`` runs the Pallas interpreter (CPU tests)."""
@@ -155,6 +177,8 @@ def apply_string_batch_pallas(state: StringState, kind, a0, a1, a2, seq,
     O = kind.shape[1]
     assert D % tile == 0, f"doc count {D} not divisible by tile {tile}"
     compact = min_seq is not None
+    K = state.prop_val.shape[2] if with_props else 0
+    np_ = _NP + K
 
     op_spec = pl.BlockSpec((tile, O), lambda i: (i, 0),
                            memory_space=pltpu.VMEM)
@@ -167,24 +191,27 @@ def apply_string_batch_pallas(state: StringState, kind, a0, a1, a2, seq,
     grid_spec = pl.GridSpec(
         grid=(D // tile,),
         in_specs=[col_spec] * n_lead + [op_spec] * _OPS
-        + [plane_spec] * _NP + [col_spec] * 2,
-        out_specs=tuple([plane_spec] * _NP + [col_spec] * 2),
+        + [plane_spec] * np_ + [col_spec] * 2,
+        out_specs=tuple([plane_spec] * np_ + [col_spec] * 2),
     )
     out_shape = tuple(
-        [jax.ShapeDtypeStruct((D, S), jnp.int32)] * _NP
+        [jax.ShapeDtypeStruct((D, S), jnp.int32)] * np_
         + [jax.ShapeDtypeStruct((D, 1), jnp.int32)] * 2)
 
     # donate the state planes into the outputs (in-place update in HBM)
-    aliases = {n_lead + _OPS + i: i for i in range(_NP + 2)}
+    aliases = {n_lead + _OPS + i: i for i in range(np_ + 2)}
     lead = (jnp.asarray(min_seq, jnp.int32)[:, None],) if compact else ()
+    prop_in = tuple(state.prop_val[:, :, i] for i in range(K))
     outs = pl.pallas_call(
-        functools.partial(_kernel, compact=compact),
+        functools.partial(_kernel, compact=compact, n_props=K),
         grid_spec=grid_spec, out_shape=out_shape,
         input_output_aliases=aliases, interpret=interpret,
     )(*lead, kind, a0, a1, a2, seq, client, ref_seq,
-      *(getattr(state, k) for k in _PLANES),
+      *(getattr(state, k) for k in _PLANES), *prop_in,
       state.count[:, None], state.overflow[:, None])
 
     planes = dict(zip(_PLANES, outs[:_NP]))
-    return StringState(**planes, prop_val=state.prop_val,
-                       count=outs[_NP][:, 0], overflow=outs[_NP + 1][:, 0])
+    prop_val = jnp.stack(outs[_NP:np_], axis=-1) if with_props \
+        else state.prop_val
+    return StringState(**planes, prop_val=prop_val,
+                       count=outs[np_][:, 0], overflow=outs[np_ + 1][:, 0])

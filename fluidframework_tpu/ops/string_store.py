@@ -80,11 +80,12 @@ def pallas_tile_for(n_docs: int, capacity: int) -> Optional[int]:
 
 
 @functools.partial(jax.jit, donate_argnums=0,
-                   static_argnames=("tile", "interpret"))
+                   static_argnames=("tile", "interpret", "with_props"))
 def _apply_pallas_jit(state, kind, a0, a1, a2, seq, client, ref_seq,
-                      tile, interpret):
+                      tile, interpret, with_props=False):
     return apply_string_batch_pallas(state, kind, a0, a1, a2, seq, client,
-                                     ref_seq, tile=tile, interpret=interpret)
+                                     ref_seq, tile=tile, interpret=interpret,
+                                     with_props=with_props)
 
 
 @functools.partial(jax.jit, donate_argnums=0,
@@ -123,7 +124,8 @@ def _columnar_apply_jit(state, rows, kind, a0, a1, base, client, ref, handle,
         # headline configuration, now the product path)
         return apply_string_batch_pallas(
             state, *planes, tile=tile, interpret=interpret,
-            min_seq=min_seq if fuse_compact else None)
+            min_seq=min_seq if fuse_compact else None,
+            with_props=with_props)
     out = apply_string_batch(state, *planes, with_props=with_props)
     if fuse_compact:
         from .merge_tree_kernel import compact_string_state
@@ -462,13 +464,23 @@ class TensorStringStore(StringOpInterner):
             self.compact(np.asarray(min_seq))
 
     def _pallas_choice(self):
-        """(use_pallas, tile, interpret) for this store's dispatch policy."""
+        """(use_pallas, tile, interpret) for this store's dispatch policy.
+        Annotate-bearing stores run the props specialization (K property
+        planes in VMEM) at a halved tile — the extra planes eat VMEM."""
         tile = pallas_tile_for(self.n_docs, self.capacity)
         mode = self.pallas
-        use_pallas = (not self._has_props and tile is not None and
+        use_pallas = (tile is not None and
                       (mode == "interpret" or
                        (mode == "auto" and
                         jax.default_backend() == "tpu")))
+        if use_pallas and self._has_props and tile > 64:
+            # props mode carries K extra planes + their temporaries in
+            # VMEM: T=64 at S=384/K=4 fits (and measures fastest: 6.98M
+            # conflict-ops/s on v5e); T=128 exceeds the 16M scoped budget
+            for smaller in (64, 32, 16, 8):
+                if smaller <= tile and self.n_docs % smaller == 0:
+                    tile = smaller
+                    break
         return use_pallas, (tile if tile is not None else 8), \
             (mode == "interpret")
 
@@ -479,7 +491,8 @@ class TensorStringStore(StringOpInterner):
         use_pallas, tile, interpret = self._pallas_choice()
         if use_pallas:
             self.state = _apply_pallas_jit(
-                self.state, *op_planes, tile=tile, interpret=interpret)
+                self.state, *op_planes, tile=tile, interpret=interpret,
+                with_props=self._has_props)
         else:
             self.state = apply_string_batch_jit(
                 self.state, *op_planes, with_props=self._has_props)

@@ -78,7 +78,7 @@ def make_replicated_step(mesh, with_props: bool = True,
         if use_pallas:
             new_state = apply_string_batch_pallas(
                 state, *full, tile=pallas_tile,
-                interpret=pallas_interpret)
+                interpret=pallas_interpret, with_props=with_props)
         else:
             new_state = apply_string_batch(state, *full,
                                            with_props=with_props)

@@ -189,6 +189,13 @@ class ContainerRuntime:
         if msg.type != MessageType.OP:
             self._emit("op", msg, local)
             return
+        # A "local" echo whose submission connection is NOT the oldest
+        # pending record's is stale: its record was already resubmitted on a
+        # newer connection (a reconnect raced an in-flight op that the
+        # service still sequenced). Peers apply it, so we apply it too — as
+        # a remote op — and leave pending state for the resubmission's echo.
+        if local and not self.pending.head_matches_connection(msg.client_id):
+            local = False
         for runtime_msg in self.inbound.process(msg):
             if local:
                 record = self.pending.process_local(runtime_msg)
@@ -235,7 +242,9 @@ class ContainerRuntime:
 
     def _submit_runtime_op(self, contents: dict,
                            metadata: Optional[dict] = None) -> None:
-        self.pending.on_submit(contents, metadata)
+        self.pending.on_submit(contents, metadata,
+                               client_id=self.client_id
+                               if self.connected else None)
         if self.connected:
             self.outbox.submit(contents, metadata)
             if self.options.flush_mode == "immediate":
@@ -263,7 +272,8 @@ class ContainerRuntime:
                         idx = i + 1
                 # pending order mirrors wire order
                 self.pending.insert_before_last(
-                    self.outbox.pending_count - idx, record, None)
+                    self.outbox.pending_count - idx, record, None,
+                    client_id=self.client_id if self.connected else None)
                 ops.insert(idx, {"contents": record, "metadata": None})
         return self.outbox.flush()
 

@@ -34,16 +34,23 @@ class PendingStateManager:
 
     # ---------------------------------------------------------------- records
 
-    def on_submit(self, contents: Any, metadata: Optional[dict] = None) -> None:
-        self._pending.append({"contents": contents, "metadata": metadata})
+    def on_submit(self, contents: Any, metadata: Optional[dict] = None,
+                  client_id: Optional[int] = None) -> None:
+        """``client_id`` stamps the connection the record is being
+        submitted under — the reconnect-era discriminator (see
+        ``head_matches_connection``)."""
+        self._pending.append({"contents": contents, "metadata": metadata,
+                              "client_id": client_id})
 
     def insert_before_last(self, n_last: int, contents: Any,
-                           metadata: Optional[dict] = None) -> None:
+                           metadata: Optional[dict] = None,
+                           client_id: Optional[int] = None) -> None:
         """Record an op that will be sent ahead of the last ``n_last``
         not-yet-flushed ops (the id-range that rides in front of its batch —
         pending order must mirror wire order)."""
         self._pending.insert(len(self._pending) - n_last,
-                             {"contents": contents, "metadata": metadata})
+                             {"contents": contents, "metadata": metadata,
+                              "client_id": client_id})
 
     @property
     def pending_count(self) -> int:
@@ -54,6 +61,16 @@ class PendingStateManager:
         return bool(self._pending)
 
     # -------------------------------------------------------------------- ack
+
+    def head_matches_connection(self, client_id: int) -> bool:
+        """Is the oldest pending record's submission connection ``client_id``?
+        False means an arriving "local" echo is STALE — the record it once
+        acked was resubmitted on a newer connection (reconnect raced an
+        in-flight op that still got sequenced). Such an echo must be applied
+        as a REMOTE op (every peer applies it; skipping would diverge) and
+        must not pop pending state (the resubmission's echo will)."""
+        return bool(self._pending) and \
+            self._pending[0].get("client_id") == client_id
 
     def process_local(self, msg: SequencedDocumentMessage) -> dict:
         """The sequenced echo of one of our runtime messages arrived; pop and

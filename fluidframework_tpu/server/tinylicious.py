@@ -47,6 +47,18 @@ class DeltaConnection:
             contents, address)
         return self._client_seq
 
+    def submit_raw(self, client_seq: int, contents: Any,
+                   type: MessageType = MessageType.OP, ref_seq: int = 0,
+                   address: Optional[str] = None) -> None:
+        """Ingest with a CLIENT-stamped clientSeq (the network ingress path:
+        the reference client stamps clientSequenceNumber itself so the
+        service can dedupe at-least-once retries; Deli enforces continuity
+        and nacks gaps/duplicates)."""
+        assert self.connected, "submit on closed connection"
+        self._client_seq = max(self._client_seq, client_seq)
+        self.service._ingest(self.doc_id, self.client_id, client_seq,
+                             ref_seq, type, contents, address)
+
     def on_op(self, fn: Callable[[SequencedDocumentMessage], None]) -> None:
         self.listeners.append(fn)
 

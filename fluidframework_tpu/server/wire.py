@@ -1,0 +1,139 @@
+"""Wire protocol for the loopback ingress tier (Alfred analog).
+
+Reference counterpart: the Socket.IO/WebSocket delta-stream protocol between
+a Fluid client and Alfred/Nexus (SURVEY.md §1, §3.5 "Socket.IO connect
+⇢net"). The reference ships JSON over WebSocket frames; here frames are
+length-prefixed JSON over TCP with a CRC32 integrity check:
+
+    frame := magic(2B "FW") | length(4B BE) | crc32(4B BE) | payload(JSON)
+
+One frame = one protocol message, a dict with ``t`` naming the kind:
+
+client → server:
+    {"t": "connect", "doc": id}                 open the delta stream
+    {"t": "op", "contents", "type", "ref_seq", "address"}
+    {"t": "signal", "contents"}
+    {"t": "deltas", "doc", "from_seq", "to_seq"}        (storage read)
+    {"t": "summary_get", "doc"}
+    {"t": "summary_put", "doc", "summary", "seq"}
+    {"t": "disconnect"}
+server → client:
+    {"t": "connected", "client_id"}
+    {"t": "op", "msg": <sequenced message>}     the broadcast stream
+    {"t": "nack", ...}
+    {"t": "signal", ...}
+    {"t": "deltas_result", "msgs": [...]}
+    {"t": "summary_result", "summary", "seq"}
+    {"t": "summary_put_result", "handle"}
+    {"t": "error", "message"}
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import zlib
+from typing import Any, Optional
+
+from ..core.protocol import MessageType, SequencedDocumentMessage
+from .deli import Nack, NackReason
+
+MAGIC = b"FW"
+_HEADER = struct.Struct("!2sII")
+HEADER_SIZE = _HEADER.size
+MAX_FRAME = 64 * 1024 * 1024  # defensive bound on one frame's payload
+
+
+class WireError(ConnectionError):
+    pass
+
+
+def encode_frame(obj: Any) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    if len(payload) > MAX_FRAME:
+        raise WireError(f"frame too large: {len(payload)}")
+    return _HEADER.pack(MAGIC, len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def decode_header(header: bytes):
+    magic, length, crc = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise WireError(f"bad magic {magic!r}")
+    if length > MAX_FRAME:
+        raise WireError(f"frame too large: {length}")
+    return length, crc
+
+
+def decode_payload(payload: bytes, crc: int) -> Any:
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise WireError("frame CRC mismatch")
+    return json.loads(payload.decode())
+
+
+# ----------------------------------------------------------- sync socket IO
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    sock.sendall(encode_frame(obj))
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    length, crc = decode_header(recv_exact(sock, _HEADER.size))
+    return decode_payload(recv_exact(sock, length), crc)
+
+
+# -------------------------------------------------------- message codecs
+
+def msg_to_wire(msg: SequencedDocumentMessage) -> dict:
+    return {
+        "doc_id": msg.doc_id, "client_id": msg.client_id,
+        "client_seq": msg.client_seq, "ref_seq": msg.ref_seq,
+        "seq": msg.seq, "min_seq": msg.min_seq, "type": int(msg.type),
+        "contents": msg.contents, "metadata": msg.metadata,
+        "address": msg.address, "timestamp": msg.timestamp,
+    }
+
+
+def msg_from_wire(d: dict) -> SequencedDocumentMessage:
+    return SequencedDocumentMessage(
+        doc_id=d["doc_id"], client_id=d["client_id"],
+        client_seq=d["client_seq"], ref_seq=d["ref_seq"], seq=d["seq"],
+        min_seq=d["min_seq"], type=MessageType(d["type"]),
+        contents=d.get("contents"), metadata=d.get("metadata"),
+        address=d.get("address"), timestamp=d.get("timestamp"))
+
+
+def nack_to_wire(nack: Nack) -> dict:
+    return {"doc_id": nack.doc_id, "client_id": nack.client_id,
+            "client_seq": nack.client_seq, "reason": int(nack.reason)}
+
+
+def nack_from_wire(d: dict) -> Nack:
+    return Nack(d["doc_id"], d["client_id"], d["client_seq"],
+                NackReason(d["reason"]))
+
+
+def wait_for_port(host: str, port: int, timeout: float = 10.0) -> None:
+    """Block until a TCP server is accepting on (host, port)."""
+    import time
+    deadline = time.monotonic() + timeout
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=1.0):
+                return
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    raise TimeoutError(f"no server on {host}:{port} after {timeout}s: {last}")

@@ -1,0 +1,81 @@
+"""Worker process for the network-ingress e2e test: one collaborator
+editing a SharedString through the FULL client stack (framework →
+runtime → loader → network driver → localhost Alfred) — every byte
+crosses a process boundary.
+
+Usage: python tests/network_worker.py PORT DOC_ID WORKER_ID N_OPS [--reconnect]
+
+Protocol: inserts its ops as ``<wid>:<j>;`` tokens, waits until it has
+seen BOTH workers' full op sets converge, prints one JSON line with the
+final text, and exits 0.
+"""
+
+import json
+import os
+import random
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402  (keep the CPU: no TPU contention from workers)
+
+jax.config.update("jax_platforms", "cpu")
+
+from fluidframework_tpu.framework.fluid_static import NetworkClient  # noqa
+
+
+SCHEMA = {"initialObjects": {"text": "sharedString"}}
+
+
+def tokens_of(text: str):
+    return re.findall(r"[0-9]+:[0-9]+;", text)
+
+
+def main() -> int:
+    port = int(sys.argv[1])
+    doc_id = sys.argv[2]
+    wid = int(sys.argv[3])
+    n_ops = int(sys.argv[4])
+    do_reconnect = "--reconnect" in sys.argv
+    rng = random.Random(wid)
+
+    client = NetworkClient(port=port, enable_summarizer=False)
+    fc = client.get_container(doc_id, SCHEMA)
+    # catch-up is synchronous at resolve: the creator's channel-create ops
+    # are already applied, so the channel exists now
+    text = fc.initial_objects["text"]
+
+    for j in range(n_ops):
+        # insert at a token boundary so tokens never interleave mid-token
+        bounds = [0] + [m.end() for m in
+                        re.finditer(r";", text.get_text())]
+        pos = rng.choice(bounds)
+        text.insert_text(pos, f"{wid}:{j};")
+        fc.flush()
+        # see own op acked before the next (keeps the trace readable)
+        want = f"{wid}:{j};"
+        fc.pump_until(lambda: want in text.get_text(), timeout=20)
+        if do_reconnect and j == n_ops // 2:
+            fc.disconnect("e2e drill")
+            fc.connect()
+
+    # wait for the OTHER worker's full op set
+    other = 1 - wid
+
+    def both_done():
+        toks = set(tokens_of(text.get_text()))
+        return all(f"{other}:{j};" in toks for j in range(n_ops)) and \
+            all(f"{wid}:{j};" in toks for j in range(n_ops))
+
+    fc.pump_until(both_done, timeout=45)
+    # settle: no more inbound for a moment → converged order
+    while fc.pump(timeout=0.3):
+        pass
+    print(json.dumps({"worker": wid, "text": text.get_text()}), flush=True)
+    fc.dispose()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

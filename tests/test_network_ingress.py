@@ -1,0 +1,187 @@
+"""The loopback ingress tier end-to-end (VERDICT r1 missing #1): a real
+socket server (Alfred analog), a network driver, and client PROCESSES
+collaborating through localhost — reconnect included."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.core.protocol import MessageType
+from fluidframework_tpu.drivers.network_driver import (
+    NetworkDocumentServiceFactory,
+)
+from fluidframework_tpu.framework.fluid_static import NetworkClient
+from fluidframework_tpu.server.ingress import AlfredServer
+from fluidframework_tpu.server import wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def server():
+    srv = AlfredServer(port=0).start_in_thread()
+    yield srv
+    srv.stop()
+
+
+# --------------------------------------------------- driver-level, in-proc
+
+def test_stream_submit_broadcast_roundtrip(server):
+    factory = NetworkDocumentServiceFactory(port=server.port)
+    svc = factory.create_document_service("d")
+    a = svc.connect_to_delta_stream()
+    b = svc.connect_to_delta_stream()
+    got_a, got_b = [], []
+    a.on_op(got_a.append)
+    b.on_op(got_b.append)
+    a.submit({"x": 1}, ref_seq=0)
+    deadline = time.monotonic() + 10
+    while (len(got_a) < 1 or len(got_b) < 1) and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert got_a and got_b
+    assert got_a[0].contents == {"x": 1} == got_b[0].contents
+    assert got_a[0].seq == got_b[0].seq
+    assert got_a[0].client_id == a.client_id
+    a.disconnect()
+    b.disconnect()
+
+
+def test_storage_requests(server):
+    factory = NetworkDocumentServiceFactory(port=server.port)
+    svc = factory.create_document_service("d2")
+    conn = svc.connect_to_delta_stream()
+    conn.submit({"n": 1})
+    conn.submit({"n": 2})
+    deadline = time.monotonic() + 10
+    while len(svc.delta_storage.get_deltas()) < 3 and \
+            time.monotonic() < deadline:  # join + 2 ops
+        time.sleep(0.01)
+    msgs = svc.delta_storage.get_deltas()
+    assert [m.contents for m in msgs if m.type == MessageType.OP] == \
+        [{"n": 1}, {"n": 2}]
+    # summary round-trip
+    assert svc.summary_storage.get_latest_summary() is None
+    svc.summary_storage.upload_summary({"tree": {"a": 1}}, seq=2)
+    got = svc.summary_storage.get_latest_summary()
+    assert got is not None and got[0] == {"tree": {"a": 1}}
+    conn.disconnect()
+
+
+def test_nack_pushed_over_wire(server):
+    factory = NetworkDocumentServiceFactory(port=server.port)
+    svc = factory.create_document_service("d3")
+    conn = svc.connect_to_delta_stream()
+    nacks = []
+    conn.on_nack(nacks.append)
+    conn._client_seq = 50  # forge a clientSeq gap
+    conn.submit({"bad": True})
+    deadline = time.monotonic() + 10
+    while not nacks and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert nacks and nacks[0].client_id == conn.client_id
+    conn.disconnect()
+
+
+def test_signals_bypass_sequencing(server):
+    factory = NetworkDocumentServiceFactory(port=server.port)
+    svc = factory.create_document_service("d4")
+    a = svc.connect_to_delta_stream()
+    b = svc.connect_to_delta_stream()
+    sigs = []
+    b.on_signal(sigs.append)
+    a.submit_signal({"cursor": 7})
+    deadline = time.monotonic() + 10
+    while not sigs and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sigs[0].contents == {"cursor": 7}
+    stored_ops = [m for m in svc.delta_storage.get_deltas()
+                  if m.type == MessageType.OP]
+    assert not stored_ops  # signals are never sequenced or stored
+    a.disconnect()
+    b.disconnect()
+
+
+def test_corrupt_frame_rejected(server):
+    import socket as socketlib
+    with socketlib.create_connection(("127.0.0.1", server.port)) as s:
+        frame = bytearray(wire.encode_frame({"t": "connect", "doc": "x"}))
+        frame[-1] ^= 0xFF  # corrupt the payload → CRC mismatch
+        s.sendall(bytes(frame))
+        # server answers with a diagnostic error frame, then drops the
+        # connection — and must not crash
+        s.settimeout(5)
+        err = wire.recv_frame(s)
+        assert err["t"] == "error" and "CRC" in err["message"]
+        assert s.recv(1024) == b""
+    # and still serve new connections
+    factory = NetworkDocumentServiceFactory(port=server.port)
+    conn = factory.create_document_service("x").connect_to_delta_stream()
+    assert conn.client_id > 0
+    conn.disconnect()
+
+
+# ------------------------------------------------- full stack, two processes
+
+SCHEMA = {"initialObjects": {"text": "sharedString"}}
+
+
+def test_two_client_processes_collaborate(server):
+    """Two OS processes co-edit one SharedString through the localhost
+    service (one of them disconnects/reconnects mid-session); their final
+    texts must converge token-for-token."""
+    creator = NetworkClient(port=server.port, enable_summarizer=False)
+    _fc, doc_id = creator.create_container(SCHEMA, doc_id="e2e-doc")
+    _fc.dispose()
+
+    n_ops = 6
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests",
+                                          "network_worker.py"),
+             str(server.port), doc_id, str(i), str(n_ops)]
+            + (["--reconnect"] if i == 1 else []),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            pytest.fail(f"worker timed out; stderr:\n{err[-2000:]}")
+        assert p.returncode == 0, err[-2000:]
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    texts = {o["worker"]: o["text"] for o in outs}
+    assert texts[0] == texts[1]
+    for w in (0, 1):
+        for j in range(n_ops):
+            assert f"{w}:{j};" in texts[0]
+
+
+def test_late_reader_sees_converged_text(server):
+    """A third client loading AFTER the session reads the same text via
+    summary-less catch-up (storage tail replay through the wire)."""
+    creator = NetworkClient(port=server.port, enable_summarizer=False)
+    fc, doc_id = creator.create_container(SCHEMA, doc_id="late-doc")
+    text = fc.initial_objects["text"]
+    text.insert_text(0, "hello ")
+    text.insert_text(6, "world")
+    fc.flush()
+    fc.pump_until(lambda: text.get_text() == "hello world", timeout=15)
+    fc.dispose()
+
+    reader = NetworkClient(port=server.port, enable_summarizer=False)
+    fc2 = reader.get_container(doc_id, SCHEMA)
+    assert fc2.initial_objects["text"].get_text() == "hello world"
+    fc2.dispose()

@@ -164,3 +164,112 @@ def test_replicated_step_pallas_matches_xla():
     assert int(agree) == 1
     assert np.array_equal(np.asarray(digest),
                           np.asarray(string_state_digest(single)))
+
+
+def _annotate_ops(seed, n_docs=8, n_ops=24):
+    """Raw op planes with interleaved annotates (packed key<<20|value)."""
+    import numpy as np
+    from fluidframework_tpu.ops.merge_tree_kernel import PROP_HANDLE_BITS
+    from fluidframework_tpu.ops.schema import OpKind
+    rng = np.random.default_rng(seed)
+    planes, _ = typing_storm(n_docs, n_ops, seed=seed)
+    kind, a0, a1, a2 = (planes[k] for k in ("kind", "a0", "a1", "a2"))
+    # turn ~1/3 of removes into annotates over the same range
+    ann = (kind == OpKind.STR_REMOVE) & (rng.random(kind.shape) < 0.5)
+    kind = np.where(ann, OpKind.STR_ANNOTATE, kind)
+    key = rng.integers(0, 4, kind.shape).astype(np.int32)
+    val = rng.integers(0, 7, kind.shape).astype(np.int32)  # 0 = delete key
+    a2 = np.where(ann, (key << PROP_HANDLE_BITS) | val, a2)
+    planes.update(kind=kind, a2=a2)
+    return tuple(jnp.asarray(planes[k]) for k in ORDER)
+
+
+def _assert_equal_with_props(a: StringState, b: StringState):
+    _assert_equal(a, b)
+    assert np.array_equal(np.asarray(a.prop_val), np.asarray(b.prop_val))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pallas_props_matches_xla(seed):
+    """The props specialization: annotate-bearing batches through the VMEM
+    kernel agree with the XLA scan, property planes included."""
+    ops = _annotate_ops(seed)
+    ref = apply_string_batch(StringState.create(8, 256), *ops,
+                             with_props=True)
+    out = apply_string_batch_pallas(StringState.create(8, 256), *ops,
+                                    tile=8, interpret=True, with_props=True)
+    _assert_equal_with_props(ref, out)
+
+
+def test_pallas_props_fused_compact_matches_xla():
+    """Active-region parity (beyond count the sort path parks dropped
+    slots, the shift path zeroes — both semantically ignored)."""
+    from fluidframework_tpu.ops.merge_tree_kernel import (
+        compact_string_state, string_state_digest,
+    )
+    ops = _annotate_ops(7)
+    ms = jnp.full((8,), 40, jnp.int32)
+    ref = compact_string_state(
+        apply_string_batch(StringState.create(8, 256), *ops,
+                           with_props=True), ms, True)
+    out = apply_string_batch_pallas(StringState.create(8, 256), *ops,
+                                    tile=8, interpret=True, with_props=True,
+                                    min_seq=ms)
+    cnt = np.asarray(out.count)
+    assert np.array_equal(cnt, np.asarray(ref.count))
+    for k in CHECK[:-2] + ("prop_val",):
+        a, b = np.asarray(getattr(out, k)), np.asarray(getattr(ref, k))
+        for d in range(8):
+            assert np.array_equal(a[d, :cnt[d]], b[d, :cnt[d]]), (k, d)
+    assert np.array_equal(np.asarray(string_state_digest(out)),
+                          np.asarray(string_state_digest(ref)))
+
+
+def test_store_annotate_stream_stays_on_pallas():
+    """An annotate-bearing store now KEEPS the fused path (props kernel)
+    and still converges with the oracle (the r1 one-way fall-off, fixed)."""
+    from fluidframework_tpu.ops.string_store import TensorStringStore
+    from tests.test_merge_tree_kernel import collab_stream
+
+    text, _, msgs = collab_stream(13, n_rounds=12, with_annotates=True)
+    store = TensorStringStore(n_docs=8, capacity=512)
+    store.pallas = "interpret"
+    store.apply_messages((2, m) for m in msgs)
+    assert store._has_props
+    use_pallas, _, _ = store._pallas_choice()
+    assert use_pallas  # props no longer kicks the store off the kernel
+    assert store.read_text(2) == text
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_conflict_storm_pallas_matches_xla(seed):
+    """The conflict-heavy corpus (divergent ref_seq, overlapping removes,
+    annotates) through BOTH kernels, multi-batch with fused compaction."""
+    from fluidframework_tpu.ops.merge_tree_kernel import (
+        compact_string_state, string_state_digest,
+    )
+    from fluidframework_tpu.testing.synthetic import conflict_storm
+
+    sp = StringState.create(8, 512)
+    sx = StringState.create(8, 512)
+    seq = 1
+    for r in range(3):
+        planes, seq = conflict_storm(8, 48, seed=seed * 10 + r,
+                                     start_seq=seq)
+        ops = tuple(jnp.asarray(planes[k]) for k in ORDER)
+        ms = np.full((8,), max(seq - 8 * 50, 0), np.int32)
+        sp = apply_string_batch_pallas(sp, *ops, tile=8, interpret=True,
+                                       with_props=True, min_seq=ms)
+        sx = compact_string_state(
+            apply_string_batch(sx, *ops, with_props=True),
+            jnp.asarray(ms), True)
+        cnt = np.asarray(sp.count)
+        assert np.array_equal(cnt, np.asarray(sx.count)), (seed, r)
+        for k in CHECK[:-2] + ("prop_val",):
+            a, b = np.asarray(getattr(sp, k)), np.asarray(getattr(sx, k))
+            for d in range(8):
+                assert np.array_equal(a[d, :cnt[d]], b[d, :cnt[d]]), \
+                    (k, seed, r, d)
+        assert np.array_equal(np.asarray(string_state_digest(sp)),
+                              np.asarray(string_state_digest(sx)))
+    assert not np.asarray(sp.overflow).any()

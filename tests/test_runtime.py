@@ -404,3 +404,58 @@ class TestReviewRegressions:
         assert 0 <= f1 < f2
         assert b.runtime.id_compressor.decompress(f1) == \
             a.runtime.id_compressor.decompress(i1)
+
+
+class TestStaleReconnectEcho:
+    def test_stale_old_connection_echo_applies_as_remote(self):
+        """A reconnect can race an in-flight op that the service still
+        sequences under the OLD client id AFTER the catch-up read: its echo
+        then arrives post-resubmission. Every peer applies that echo, so we
+        must too — as a REMOTE op — while pending state waits for the
+        resubmission's echo (code-review r2 finding: the old behavior
+        crashed on the empty/mismatched pending deque)."""
+        wire_log = []
+        rt = ContainerRuntime(lambda contents: wire_log.append(contents),
+                              options=ContainerRuntimeOptions(
+                                  enable_id_compressor=False,
+                                  grouped_batching=False),
+                              client_id=1)
+        peer = ContainerRuntime(lambda contents: None,
+                                options=ContainerRuntimeOptions(
+                                    enable_id_compressor=False,
+                                    grouped_batching=False),
+                                client_id=9)
+        m = rt.create_data_store("default").create_channel("r", "map")
+        rt.flush()
+        attach_ops = list(wire_log)
+        wire_log.clear()
+        m.set("k", "v1")
+        rt.flush()
+        assert len(wire_log) == 1
+        original = wire_log.pop()
+
+        # reconnect: pending records resubmit under the NEW client id
+        rt.set_connection_state(False, None)
+        rt.set_connection_state(True, 2)
+        rt.flush()
+        resubmits = list(wire_log)
+        assert resubmits  # the attach ops + set were all still pending
+
+        def seq_msgs(payloads, client_id, start_seq):
+            return [SequencedDocumentMessage(
+                doc_id="d", client_id=client_id, client_seq=i + 1,
+                ref_seq=0, seq=start_seq + i, min_seq=0,
+                type=MessageType.OP, contents=c)
+                for i, c in enumerate(payloads)]
+
+        # the STALE echoes (old id) arrive first — after resubmission
+        stale = seq_msgs(attach_ops + [original], 1, 1)
+        # then the resubmission's echoes (new id)
+        fresh = seq_msgs(resubmits, 2, 1 + len(stale))
+        for msg in stale + fresh:
+            rt.process(msg, local=(msg.client_id in (1, 2)))
+            peer.process(msg, local=False)
+        assert not rt.pending.has_pending
+        got = rt.get_data_store("default").get_channel("r")
+        got_peer = peer.get_data_store("default").get_channel("r")
+        assert got.get("k") == "v1" == got_peer.get("k")
