@@ -1,0 +1,285 @@
+"""Host facade for the batched tree kernel: many SharedTree documents
+resident on device.
+
+Mirrors ``TensorStringStore``'s division of labor: the host interns
+variable-size identities (node-id strings, field names, type names, JSON
+values) into int32 handles and EXPANDS each oracle op dict into the guard +
+record stream of ``tree_kernel`` (its module docstring documents the
+grouping protocol); the device does all merge math. Reads reconstruct the
+oracle's ``to_dict`` shape by walking the sibling linked lists host-side.
+
+Reference counterpart: the serving half of ``@fluidframework/tree``
+(SURVEY.md §2.6); oracle: ``models.shared_tree``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .schema import ValueInterner
+from .tree_kernel import (
+    META_NESTED, ROOT_HANDLE, TreeOpKind, TreeState, _TREE_PLANES,
+    apply_tree_batch_jit, tree_state_digest,
+)
+
+ROOT = "root"
+
+
+class _Interner:
+    """str ↔ dense int32 handle (1-based; 0 = none)."""
+
+    def __init__(self, reserved=()):
+        self._ids: Dict[str, int] = {}
+        self._names: List[Optional[str]] = [None]
+        for name in reserved:
+            self.handle(name)
+
+    def handle(self, name: str) -> int:
+        if name not in self._ids:
+            self._ids[name] = len(self._names)
+            self._names.append(name)
+        return self._ids[name]
+
+    def name(self, handle: int) -> Optional[str]:
+        return self._names[handle]
+
+    def export(self) -> list:
+        return list(self._names)
+
+    @classmethod
+    def restore(cls, names: list) -> "_Interner":
+        it = cls()
+        for n in names[1:]:
+            it.handle(n)
+        return it
+
+
+class TensorTreeStore:
+    def __init__(self, n_docs: int, capacity: int = 256):
+        self.n_docs = n_docs
+        self.capacity = capacity
+        self.state = TreeState.create(n_docs, capacity)
+        self._ids = _Interner(reserved=(ROOT,))      # handle 1 == ROOT
+        assert self._ids.handle(ROOT) == ROOT_HANDLE
+        self._fields = _Interner()
+        self._types = _Interner()
+        self._values = ValueInterner()
+
+    # ----------------------------------------------------------- translation
+
+    def _rec(self, kind, node=0, parent=0, after=0, field=0, value=0,
+             type_=0, meta=0):
+        return (int(kind), node, parent, after, field, value, type_, meta)
+
+    def _vh(self, value) -> int:
+        return 0 if value is None else self._values.handle(value)
+
+    def _th(self, type_name) -> int:
+        return 0 if type_name is None else self._types.handle(type_name)
+
+    def _expand_insert(self, op: dict, out: list) -> None:
+        """INS_BEGIN + one absent-guard per top-level spec + DFS records
+        (nested records carry META_NESTED: 'parent created by this op')."""
+        out.append(self._rec(TreeOpKind.INS_BEGIN))
+        for spec in op["nodes"]:
+            out.append(self._rec(TreeOpKind.INS_GUARD_ABSENT,
+                                 node=self._ids.handle(spec["id"])))
+        after = self._ids.handle(op["after"]) if op.get("after") else 0
+        parent = self._ids.handle(op["parent"])
+        field = self._fields.handle(op["field"])
+        for spec in op["nodes"]:
+            self._expand_spec(spec, parent, field, after, nested=False,
+                              out=out)
+            after = self._ids.handle(spec["id"])
+
+    def _expand_spec(self, spec: dict, parent: int, field: int, after: int,
+                     nested: bool, out: list) -> None:
+        nid = self._ids.handle(spec["id"])
+        out.append(self._rec(
+            TreeOpKind.INSERT, node=nid, parent=parent, after=after,
+            field=field, value=self._vh(spec.get("value")),
+            type_=self._th(spec.get("type")),
+            meta=META_NESTED if nested else 0))
+        for fname, child_specs in (spec.get("children") or {}).items():
+            fh = self._fields.handle(fname)
+            prev = 0
+            for child in child_specs:
+                self._expand_spec(child, nid, fh, prev, nested=True,
+                                  out=out)
+                prev = self._ids.handle(child["id"])
+
+    def _expand_edit(self, op: dict, out: list) -> None:
+        kind = op["op"]
+        if kind == "insert":
+            self._expand_insert(op, out)
+        elif kind == "remove":
+            out.append(self._rec(TreeOpKind.INS_BEGIN))
+            out.append(self._rec(TreeOpKind.REMOVE,
+                                 node=self._ids.handle(op["id"])))
+        elif kind == "move":
+            out.append(self._rec(TreeOpKind.INS_BEGIN))
+            out.append(self._rec(
+                TreeOpKind.MOVE, node=self._ids.handle(op["id"]),
+                parent=self._ids.handle(op["parent"]),
+                after=self._ids.handle(op["after"]) if op.get("after")
+                else 0,
+                field=self._fields.handle(op["field"])))
+        elif kind == "setValue":
+            out.append(self._rec(TreeOpKind.INS_BEGIN))
+            out.append(self._rec(TreeOpKind.SET_VALUE,
+                                 node=self._ids.handle(op["id"]),
+                                 value=self._vh(op["value"])))
+        elif kind == "transaction":
+            for sub in op["edits"]:
+                self._expand_edit(sub, out)
+        else:
+            raise ValueError(f"unknown tree op {kind!r}")
+
+    def _records_for(self, msg) -> list:
+        """Expanded device records for one sequenced tree message."""
+        op = msg.contents
+        out: list = [self._rec(TreeOpKind.TXN_BEGIN)]
+        if op["op"] == "transaction":
+            for c in op.get("constraints", ()):
+                if "nodeExists" in c:
+                    out.append(self._rec(
+                        TreeOpKind.TXN_GUARD_EXISTS,
+                        node=self._ids.handle(c["nodeExists"])))
+        self._expand_edit(op, out)
+        return out
+
+    # ----------------------------------------------------------------- apply
+
+    def apply_messages(self, messages) -> None:
+        per_doc: Dict[int, list] = {}
+        for doc, msg in messages:
+            recs = self._records_for(msg)
+            rows = per_doc.setdefault(doc, [])
+            rows.extend((r, msg.seq) for r in recs)
+        if not per_doc:
+            return
+        widest = max(len(v) for v in per_doc.values())
+        o = 8
+        while o < widest:
+            o *= 2
+        planes = np.zeros((9, self.n_docs, o), np.int32)
+        for doc, recs in per_doc.items():
+            for j, (r, seq) in enumerate(recs):
+                planes[0, doc, j] = r[0]        # kind
+                planes[1:8, doc, j] = r[1:]     # node..meta → 1..7
+                planes[8, doc, j] = seq
+        # plane order for the kernel: kind,node,parent,after,field,value,
+        # type_,seq,meta
+        self.state = apply_tree_batch_jit(
+            self.state, jnp.asarray(planes[0]), jnp.asarray(planes[1]),
+            jnp.asarray(planes[2]), jnp.asarray(planes[3]),
+            jnp.asarray(planes[4]), jnp.asarray(planes[5]),
+            jnp.asarray(planes[6]), jnp.asarray(planes[8]),
+            jnp.asarray(planes[7]))
+
+    # ----------------------------------------------------------------- reads
+
+    def _pull(self, doc: int) -> dict:
+        st = self.state
+        return {k: np.asarray(getattr(st, k)[doc]) for k in _TREE_PLANES}
+
+    def to_dict(self, doc: int) -> dict:
+        """The oracle's ``to_dict`` shape, rebuilt from the planes."""
+        p = self._pull(doc)
+        live = p["node_id"] != 0
+        by_id = {int(p["node_id"][i]): i for i in range(self.capacity)
+                 if live[i]}
+
+        def node_dict(nid: int) -> dict:
+            i = by_id[nid]
+            out = {"id": self._ids.name(nid),
+                   "type": self._types.name(int(p["type_"][i]))
+                   if p["type_"][i] else None,
+                   "value": self._values.value(int(p["value"][i]))
+                   if p["value"][i] else None}
+            # group children by field, ordered by the linked list
+            fields: Dict[int, list] = {}
+            for j in range(self.capacity):
+                if live[j] and int(p["parent"][j]) == nid:
+                    fields.setdefault(int(p["field"][j]), []).append(j)
+            children = {}
+            for fh, slots in fields.items():
+                ordered = self._chain_order(p, slots)
+                children[self._fields.name(fh)] = [
+                    node_dict(int(p["node_id"][j])) for j in ordered]
+            if children:
+                out["children"] = dict(sorted(children.items()))
+            return out
+
+        return node_dict(ROOT_HANDLE)
+
+    def _chain_order(self, p, slots: list) -> list:
+        """Order sibling slots by their prev/next chain (head: prev == 0)."""
+        by_id = {int(p["node_id"][j]): j for j in slots}
+        head = [j for j in slots if int(p["prev_sib"][j]) == 0]
+        assert len(head) == 1, "broken sibling chain"
+        order = [head[0]]
+        while True:
+            nxt = int(p["next_sib"][order[-1]])
+            if nxt == 0:
+                break
+            order.append(by_id[nxt])
+        assert len(order) == len(slots), "sibling chain mismatch"
+        return order
+
+    def node_value(self, doc: int, node_id: str):
+        p = self._pull(doc)
+        nh = self._ids.handle(node_id)
+        sel = p["node_id"] == nh
+        if not sel.any():
+            raise KeyError(node_id)
+        return self._values.value(int(p["value"][sel][0])) \
+            if p["value"][sel][0] else None
+
+    def has_node(self, doc: int, node_id: str) -> bool:
+        if node_id not in self._ids._ids:
+            return False
+        return bool((self._pull(doc)["node_id"] ==
+                     self._ids.handle(node_id)).any())
+
+    def node_count(self, doc: int) -> int:
+        return int((np.asarray(self.state.node_id[doc]) != 0).sum())
+
+    def overflowed(self) -> np.ndarray:
+        return np.asarray(self.state.overflow)
+
+    def digests(self) -> np.ndarray:
+        return np.asarray(tree_state_digest(self.state))
+
+    # ----------------------------------------------------- snapshot / resume
+
+    def snapshot(self) -> dict:
+        st = self.state
+        return {
+            "planes": {k: np.asarray(getattr(st, k)).copy()
+                       for k in _TREE_PLANES},
+            "overflow": np.asarray(st.overflow).copy(),
+            "capacity": self.capacity,
+            "ids": self._ids.export(),
+            "fields": self._fields.export(),
+            "types": self._types.export(),
+            "values": self._values.export(),
+        }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "TensorTreeStore":
+        n_docs = snap["overflow"].shape[0]
+        store = cls.__new__(cls)
+        store.n_docs = n_docs
+        store.capacity = snap["capacity"]
+        store.state = TreeState(
+            **{k: jnp.asarray(snap["planes"][k]) for k in _TREE_PLANES},
+            overflow=jnp.asarray(snap["overflow"]))
+        store._ids = _Interner.restore(snap["ids"])
+        store._fields = _Interner.restore(snap["fields"])
+        store._types = _Interner.restore(snap["types"])
+        store._values = ValueInterner.restore(snap["values"])
+        return store
