@@ -14,6 +14,7 @@ Reference counterpart: the serving half of ``@fluidframework/tree``
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
@@ -250,6 +251,65 @@ class TensorTreeStore:
 
     def overflowed(self) -> np.ndarray:
         return np.asarray(self.state.overflow)
+
+    # -------------------------------------------------- overflow recovery ops
+    # (the serving engine's escape hatch — mirrors TensorStringStore's
+    # clear_doc/adopt_doc so tree recovery stays the same shape)
+
+    def share_interners(self, other: "TensorTreeStore") -> None:
+        """Alias ``other``'s interner tables (append-only) so handles in
+        this store mean the same strings/values as in ``other`` — the
+        precondition for ``other.adopt_doc`` copying our planes verbatim."""
+        self._ids = other._ids
+        self._fields = other._fields
+        self._types = other._types
+        self._values = other._values
+
+    def clear_doc(self, row: int) -> None:
+        """Reset one row to the empty tree (root only, overflow cleared)."""
+        st = self.state
+        fresh = TreeState.create(1, self.capacity)
+        self.state = dataclasses.replace(
+            st,
+            **{k: getattr(st, k).at[row].set(getattr(fresh, k)[0])
+               for k in _TREE_PLANES},
+            overflow=st.overflow.at[row].set(0))
+
+    def high_water(self, doc: int = 0) -> int:
+        """1 + highest live slot index (root counts), for fit checks."""
+        live = np.asarray(self.state.node_id[doc]) != 0
+        return int(np.max(np.nonzero(live)[0])) + 1 if live.any() else 0
+
+    def repack(self, doc: int = 0) -> None:
+        """Compact a doc's live slots to the lowest indices. Slot position
+        carries NO meaning in this representation (order/attachment are id
+        handles — tree_kernel module docstring), so this is a pure
+        permutation; it exists so a rebuilt doc whose history churned
+        through many slots fits back into a small tier."""
+        st = self.state
+        p = {k: np.asarray(getattr(st, k)[doc]) for k in _TREE_PLANES}
+        live = np.nonzero(p["node_id"] != 0)[0]
+        updates = {}
+        for k in _TREE_PLANES:
+            row = np.zeros((self.capacity,), np.int32)
+            row[:len(live)] = p[k][live]
+            updates[k] = getattr(st, k).at[doc].set(jnp.asarray(row))
+        self.state = dataclasses.replace(st, **updates)
+
+    def adopt_doc(self, row: int, tmp: "TensorTreeStore") -> None:
+        """Upload single-doc store ``tmp`` (which MUST share this store's
+        interners — see ``share_interners``) into ``row``. Caller checks
+        ``tmp.high_water() <= self.capacity`` first."""
+        hw = tmp.high_water()
+        assert hw <= self.capacity, "doc does not fit this tier"
+        st = self.state
+        updates = {}
+        for k in _TREE_PLANES:
+            src = np.zeros((self.capacity,), np.int32)
+            src[:hw] = np.asarray(getattr(tmp.state, k)[0, :hw])
+            updates[k] = getattr(st, k).at[row].set(jnp.asarray(src))
+        self.state = dataclasses.replace(
+            st, **updates, overflow=st.overflow.at[row].set(0))
 
     def digests(self) -> np.ndarray:
         return np.asarray(tree_state_digest(self.state))

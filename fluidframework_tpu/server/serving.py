@@ -1193,3 +1193,257 @@ class MatrixServingEngine(ServingEngineBase):
         engine._replay_tail(summary)
         engine.flush()
         return engine
+
+
+class TreeServingEngine(ServingEngineBase):
+    """Serving engine for SharedTree documents (SURVEY.md §2.6's serving
+    half): the same Deli + durable log + batch-window + summary/tail-replay
+    pipeline as the string engine, over the batched tree kernel
+    (``TensorTreeStore``). Ops are the SharedTree oracle wire dicts
+    (insert/remove/move/setValue/transaction — ``models/shared_tree.py``'s
+    module docstring is the merge spec; the kernel reproduces it on device).
+
+    Capacity story: node slots are per-doc-row; an insert that finds no
+    free slot sets the doc's sticky overflow flag and drops the op
+    device-side. ``recover_overflowed`` is the escape hatch — rebuild the
+    doc from its full log history at doubled capacity (same apply kernel),
+    then re-upload into its row if it fits or graduate it to its own
+    right-sized single-doc store (terminal tier), exactly the string
+    engine's recovery shape."""
+
+    def __init__(self, n_docs: int, capacity: int = 256,
+                 batch_window: int = 64, n_partitions: int = 8,
+                 log: Optional[PartitionedLog] = None,
+                 store: Optional["TensorTreeStore"] = None):
+        from ..ops.tree_store import TensorTreeStore
+        super().__init__(batch_window, n_partitions, log=log)
+        self.store = store if store is not None \
+            else TensorTreeStore(n_docs, capacity)
+        self.n_docs = n_docs
+        self.capacity = self.store.capacity
+        # terminal tier: docs too big for the batched store, each in its
+        # own single-doc store sharing the main store's interners
+        self._graduated: Dict[str, Any] = {}
+        self._grad_queue: Dict[str, List[SequencedDocumentMessage]] = {}
+
+    # ------------------------------------------------------------ validation
+
+    _EDIT_KINDS = ("insert", "remove", "move", "setValue", "transaction")
+
+    def _valid_spec(self, spec: Any, depth: int = 0) -> bool:
+        if depth > 64 or not isinstance(spec, dict) \
+                or not isinstance(spec.get("id"), str) or not spec["id"]:
+            return False
+        if spec.get("type") is not None \
+                and not isinstance(spec["type"], str):
+            return False
+        try:
+            json.dumps(spec.get("value"))
+        except (TypeError, ValueError):
+            return False
+        kids = spec.get("children")
+        if kids is None:
+            return True
+        if not isinstance(kids, dict):
+            return False
+        for field, specs in kids.items():
+            if not isinstance(field, str) or not isinstance(specs, list):
+                return False
+            if not all(self._valid_spec(c, depth + 1) for c in specs):
+                return False
+        return True
+
+    def _valid_edit(self, op: Any, depth: int = 0) -> bool:
+        if depth > 8 or not isinstance(op, dict) \
+                or op.get("op") not in self._EDIT_KINDS:
+            return False
+        kind = op["op"]
+        if kind == "insert":
+            return (isinstance(op.get("parent"), str)
+                    and isinstance(op.get("field"), str)
+                    and (op.get("after") is None
+                         or isinstance(op["after"], str))
+                    and isinstance(op.get("nodes"), list)
+                    and len(op["nodes"]) >= 1
+                    and all(self._valid_spec(s) for s in op["nodes"]))
+        if kind == "remove":
+            return isinstance(op.get("id"), str) and bool(op["id"])
+        if kind == "move":
+            return (isinstance(op.get("id"), str)
+                    and isinstance(op.get("parent"), str)
+                    and isinstance(op.get("field"), str)
+                    and (op.get("after") is None
+                         or isinstance(op["after"], str)))
+        if kind == "setValue":
+            # "value" must be PRESENT (the expand path reads op["value"]):
+            # an acked-and-logged op flush cannot apply poisons recovery
+            if not isinstance(op.get("id"), str) or "value" not in op:
+                return False
+            try:
+                json.dumps(op["value"])
+            except (TypeError, ValueError):
+                return False
+            return True
+        # transaction
+        cons = op.get("constraints", [])
+        if not (isinstance(cons, list)
+                and all(isinstance(c, dict)
+                        and isinstance(c.get("nodeExists"), str)
+                        for c in cons)):
+            return False
+        return (isinstance(op.get("edits"), list) and len(op["edits"]) >= 1
+                and all(self._valid_edit(e, depth + 1)
+                        for e in op["edits"]))
+
+    def _valid_op(self, contents: Any) -> bool:
+        return self._valid_edit(contents)
+
+    # ----------------------------------------------------------- device side
+
+    def _admit(self, doc_id: str, contents: Any) -> None:
+        if doc_id not in self._graduated:
+            # graduated docs own their store; don't re-pin a tier row
+            self.doc_row(doc_id)
+
+    def _enqueue(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
+        if doc_id in self._graduated:
+            self._grad_queue.setdefault(doc_id, []).append(msg)
+        else:
+            self._queue.append((self.doc_row(doc_id), msg))
+
+    def _queued(self) -> int:
+        return len(self._queue) + sum(map(len, self._grad_queue.values()))
+
+    def _flush_impl(self) -> int:
+        n = len(self._queue)
+        if self._queue:
+            self.store.apply_messages(self._queue)
+            self._queue.clear()
+        for doc_id, msgs in self._grad_queue.items():
+            if msgs:
+                self._graduated[doc_id].apply_messages(
+                    (0, m) for m in msgs)
+                n += len(msgs)
+                msgs.clear()
+        return n
+
+    def _store_of(self, doc_id: str):
+        """(store, row) owning this doc, post-flush."""
+        if doc_id in self._graduated:
+            return self._graduated[doc_id], 0
+        return self.store, self.doc_row(doc_id)
+
+    # ----------------------------------------------------------------- reads
+
+    def to_dict(self, doc_id: str) -> dict:
+        self.flush()
+        store, row = self._store_of(doc_id)
+        return store.to_dict(row)
+
+    def node_value(self, doc_id: str, node_id: str):
+        self.flush()
+        store, row = self._store_of(doc_id)
+        return store.node_value(row, node_id)
+
+    def has_node(self, doc_id: str, node_id: str) -> bool:
+        self.flush()
+        store, row = self._store_of(doc_id)
+        return store.has_node(row, node_id)
+
+    def node_count(self, doc_id: str) -> int:
+        self.flush()
+        store, row = self._store_of(doc_id)
+        return store.node_count(row)
+
+    # ----------------------------------------------------- overflow recovery
+
+    def overflowed_docs(self) -> List[str]:
+        flags = self.store.overflowed()
+        out = [d for d, row in self._doc_rows.items() if flags[row]]
+        out += [d for d, s in self._graduated.items()
+                if s.overflowed().any()]
+        return out
+
+    def _doc_log_messages(self, doc_id: str):
+        """Every sequenced OP message for one doc, seq-ascending (a doc
+        lives entirely in one partition — see string engine)."""
+        p = partition_of(doc_id, self.log.n_partitions)
+        msgs = [rec for rec in self.log.read(p)
+                if not isinstance(rec, ColumnarOps)
+                and rec.doc_id == doc_id and rec.type == MessageType.OP]
+        msgs.sort(key=lambda m: m.seq)
+        return msgs
+
+    def _rebuild_doc(self, doc_id: str, start_capacity: int,
+                     grow_limit: int):
+        """Replay the doc's full log history into a fresh single-doc store
+        (sharing the batched store's interners so its planes can be adopted
+        verbatim), doubling capacity until it fits."""
+        from ..ops.tree_store import TensorTreeStore
+        msgs = self._doc_log_messages(doc_id)
+        cap = max(start_capacity, 64)
+        while True:
+            cap *= 2
+            if cap > grow_limit:
+                raise MemoryError(
+                    f"{doc_id}: rebuild exceeds grow limit {grow_limit}")
+            tmp = TensorTreeStore(1, cap)
+            tmp.share_interners(self.store)
+            tmp.apply_messages((0, m) for m in msgs)
+            if not tmp.overflowed().any():
+                tmp.repack()   # slot churn must not inflate the fit check
+                return tmp
+
+    def recover_overflowed(self, grow_limit: int = 1 << 16
+                           ) -> Dict[str, str]:
+        """Drain every overflowed doc's history through a right-sized
+        rebuild; re-upload or graduate. Zero acked ops are lost: the log
+        has every sequenced op. {doc_id: "reuploaded"|"graduated"|
+        "regrown"}."""
+        self.flush()  # queues must be empty: the rebuild replays the log
+        report: Dict[str, str] = {}
+        flags = self.store.overflowed()
+        for doc_id in [d for d, r in self._doc_rows.items() if flags[r]]:
+            row = self._doc_rows[doc_id]
+            tmp = self._rebuild_doc(doc_id, self.store.capacity, grow_limit)
+            if tmp.high_water() <= self.store.capacity:
+                self.store.adopt_doc(row, tmp)
+                report[doc_id] = "reuploaded"
+            else:
+                self.store.clear_doc(row)
+                self._graduated[doc_id] = tmp
+                self._free_rows.append(self._doc_rows.pop(doc_id))
+                report[doc_id] = "graduated"
+        # the terminal tier can overflow too: rebuild in place, doubled
+        for doc_id, store in list(self._graduated.items()):
+            if store.overflowed().any():
+                self._graduated[doc_id] = self._rebuild_doc(
+                    doc_id, store.capacity, grow_limit)
+                report[doc_id] = "regrown"
+        if report:
+            self.metrics.inc("overflow_recoveries", len(report))
+        return report
+
+    # ----------------------------------------------------- summary / recovery
+
+    def summarize(self) -> dict:
+        self.flush()
+        summary = self._base_summary()
+        summary["store"] = self.store.snapshot()
+        summary["graduated"] = {d: s.snapshot()
+                                for d, s in self._graduated.items()}
+        return summary
+
+    @classmethod
+    def load(cls, summary: dict, log: PartitionedLog,
+             **kwargs) -> "TreeServingEngine":
+        from ..ops.tree_store import TensorTreeStore
+        store = TensorTreeStore.restore(summary["store"])
+        engine = cls(store.n_docs, store.capacity, log=log, store=store,
+                     **kwargs)
+        engine._restore_base(summary)
+        for doc_id, snap in summary["graduated"].items():
+            engine._graduated[doc_id] = TensorTreeStore.restore(snap)
+        engine._replay_tail(summary)
+        engine.flush()
+        return engine

@@ -31,12 +31,23 @@ class MockSequencer:
         self._replicas: List[Any] = []
         self._client_ref_seq: Dict[int, int] = {}
         self._next_client_id = 1
+        self._sequenced_listeners: List[Callable[
+            [SequencedDocumentMessage], None]] = []
 
     # ------------------------------------------------------------ membership
 
     def connect(self, replica: Any) -> None:
         self._replicas.append(replica)
         self._client_ref_seq[replica.client_id] = self.seq
+        # a bare SharedObject (has the submit plumbing but nothing wired)
+        # gets its outbound channel attached here too, so tests can write
+        # `seqr.connect(dds)` and have the full loop — matching the
+        # reference's MockContainerRuntimeFactory.createContainerRuntime
+        # which wires both directions in one call
+        if getattr(replica, "_submit_fn", False) is None \
+                and hasattr(replica, "connect"):
+            replica.connect(lambda contents, r=replica:
+                            self.submit(r, contents))
 
     def disconnect(self, replica: Any) -> None:
         self._replicas.remove(replica)
@@ -46,6 +57,14 @@ class MockSequencer:
         cid = self._next_client_id
         self._next_client_id += 1
         return cid
+
+    def on_sequenced(
+            self, cb: Callable[[SequencedDocumentMessage], None]) -> None:
+        """Subscribe to the sequenced stream (Broadcaster-tap analog):
+        ``cb`` is invoked with every stamped message, after replica
+        delivery — lets tests capture the exact wire stream a serving
+        engine / device store would consume."""
+        self._sequenced_listeners.append(cb)
 
     # ----------------------------------------------------------- op pipeline
 
@@ -97,6 +116,8 @@ class MockSequencer:
         )
         for replica in list(self._replicas):
             replica.apply_msg(msg)
+        for cb in self._sequenced_listeners:
+            cb(msg)
         return msg
 
     def process_some(self, n: int) -> int:
