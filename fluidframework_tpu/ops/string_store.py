@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import heapq
+import json
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -88,37 +89,108 @@ def _apply_pallas_jit(state, kind, a0, a1, a2, seq, client, ref_seq,
                                      with_props=with_props)
 
 
-@functools.partial(jax.jit, donate_argnums=0,
-                   static_argnames=("use_pallas", "tile", "interpret",
-                                    "with_props", "scatter_rows", "n_docs",
-                                    "fuse_compact"))
-def _columnar_apply_jit(state, rows, kind, a0, a1, base, client, ref, handle,
-                        min_seq, use_pallas, tile, interpret, with_props,
-                        scatter_rows, n_docs, fuse_compact):
-    """Device-side unpack of a packed columnar batch: the host ships narrow
-    dtypes (kind/client int8, a0/a1 int16 when they fit) and per-row seq
-    BASES instead of full int32 planes — host→device bytes are the columnar
-    path's bottleneck over a tunnel-attached device. seq = base + running
-    count of non-NOOP slots (nacked ops were NOOP-masked host-side and
-    consumed no sequence number); a2 = the broadcast payload handle on
-    inserts; ref clamps to seq-1 (mirroring Deli)."""
-    kind = kind.astype(jnp.int32)
+@functools.partial(jax.jit,
+                   static_argnames=("R", "O", "pos_wide", "ref_wide",
+                                    "rich", "n_docs", "fuse_compact",
+                                    "scatter_rows", "compact8"))
+def _columnar_unpack_jit(buf, R, O, pos_wide, ref_wide, rich, n_docs,
+                         fuse_compact, scatter_rows, compact8=False):
+    """Device-side unpack of ONE byte-packed columnar batch. The host
+    concatenates every op plane into a single uint8 buffer — kind u8,
+    client-idx u8, a0/a1 (i16, or i32 when ``pos_wide``), ref (u16 LAG
+    behind the op's own seq, or full i32 when ``ref_wide``), a2 (one
+    broadcast i32 handle, or an (N,) i32 plane when ``rich``), the
+    per-row seq bases, the row indices, and the fused min_seq — because
+    over a tunnel-attached device EACH transfer pays the link round-trip
+    and the wire bytes ARE the columnar path's bottleneck (measured: 7
+    per-plane transfers cost ~5× the fused apply itself; one fused buffer
+    at 8 B/op restores the kernel rate).
+
+    seq = base + running count of non-NOOP slots (nacked ops were
+    NOOP-masked host-side and consumed no sequence number); ref clamps to
+    seq-1 (mirroring Deli).
+
+    This is deliberately its OWN jit (not fused into the merge program),
+    and the buffer is INT32 WORDS unpacked by shift/mask — not u8 +
+    bitcast: both the u8-bitcast form and fusing the unpack into the
+    scan/compact body pathologically explode XLA's TPU compile time
+    (seconds → many minutes at 10k-doc shapes, measured); this form
+    compiles in seconds and the unpacked planes stay on device."""
+    N = R * O
+
+    def take_u8(off, n):
+        w = -(-n // 4)
+        words = jax.lax.slice_in_dim(buf, off, off + w, axis=0)
+        v = jnp.stack([words & 0xFF, (words >> 8) & 0xFF,
+                       (words >> 16) & 0xFF, (words >> 24) & 0xFF],
+                      axis=1).reshape(4 * w)[:n]
+        return v, off + w
+
+    def take_u16(off, n):
+        w = -(-n // 2)
+        words = jax.lax.slice_in_dim(buf, off, off + w, axis=0)
+        v = jnp.stack([words & 0xFFFF, (words >> 16) & 0xFFFF],
+                      axis=1).reshape(2 * w)[:n]
+        return v, off + w
+
+    def take_i32(off, n):
+        return jax.lax.slice_in_dim(buf, off, off + n, axis=0), off + n
+
+    if compact8:
+        # 5 B/op profile: [kind(2b)|cidx(6b)] u8, a0 u16, span-delta u8
+        # (a1 = a0+delta for remove/annotate, payload length for insert),
+        # lag u8. NOOP (=12) rides as code 3 in the 2-bit field.
+        kc, off = take_u8(0, N)
+        kind = kc & 0x3
+        kind = jnp.where(kind == 3, int(OpKind.NOOP), kind)
+        client = kc >> 2
+        a0, off = take_u16(off, N)
+        delta, off = take_u8(off, N)
+        a1 = jnp.where(kind == int(OpKind.STR_INSERT), delta, a0 + delta)
+        ref, off = take_u8(off, N)
+    else:
+        take_pos = take_i32 if pos_wide else take_u16
+        kind, off = take_u8(0, N)
+        client, off = take_u8(off, N)
+        a0, off = take_pos(off, N)
+        a1, off = take_pos(off, N)
+        ref, off = (take_i32 if ref_wide else take_u16)(off, N)
+    a2, off = take_i32(off, N if rich else 1)
+    base, off = take_i32(off, R)
+    rows, off = take_i32(off, R)
+    min_seq, off = take_i32(off, n_docs if fuse_compact else 1)
+
+    kind = kind.reshape(R, O)
     valid = kind != int(OpKind.NOOP)
     seq = base[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1)
-    a0 = a0.astype(jnp.int32)
-    a1 = a1.astype(jnp.int32)
-    client = client.astype(jnp.int32)
-    ref = jnp.minimum(ref.astype(jnp.int32), seq - 1)
-    a2 = jnp.where(kind == int(OpKind.STR_INSERT), handle, 0)
+    a0 = a0.reshape(R, O)
+    a1 = a1.reshape(R, O)
+    client = client.reshape(R, O)
+    if ref_wide and not compact8:
+        ref = jnp.minimum(ref.reshape(R, O), seq - 1)
+    else:  # lag encoding: ref = seq - lag, lag >= 1 (the Deli clamp)
+        ref = seq - jnp.maximum(ref.reshape(R, O), 1)
+    a2 = a2.reshape(R, O) if rich else jnp.broadcast_to(a2, (R, O))
+    a2 = jnp.where((kind == int(OpKind.STR_INSERT))
+                   | (kind == int(OpKind.STR_ANNOTATE)), a2, 0)
     planes = (kind, a0, a1, a2, seq, client, ref)
     if scatter_rows:
-        O = kind.shape[1]
-
         def full(p, fill):
             return jnp.full((n_docs, O), fill, jnp.int32).at[rows].set(p)
 
         planes = (full(planes[0], int(OpKind.NOOP)),) + \
             tuple(full(p, 0) for p in planes[1:])
+    return planes, min_seq
+
+
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("use_pallas", "tile", "interpret",
+                                    "with_props", "fuse_compact"))
+def _columnar_merge_jit(state, planes, min_seq, use_pallas, tile,
+                        interpret, with_props, fuse_compact):
+    """The merge half of the columnar apply (device-resident planes from
+    ``_columnar_unpack_jit``): fused Pallas apply+zamboni when eligible,
+    else the XLA scan (+ fused compact)."""
     if use_pallas:
         # fused apply+zamboni: ONE dispatch, planes stay in VMEM (the r1
         # headline configuration, now the product path)
@@ -233,6 +305,23 @@ class StringOpInterner:
         for k in new_keys:
             self._prop_plane(k)
         return new_keys
+
+    def reserve_prop_tables(self, keys, values) -> None:
+        """Columnar-ingest admission: reserve planes for every key in
+        ``keys`` (atomic, as ``reserve_props``) and check value-table
+        headroom for the DISTINCT uninterned values in ``values`` — the
+        whole batch is admitted or none of it, before sequencing."""
+        new_keys = [k for k in keys if k not in self._prop_planes]
+        if len(self._prop_planes) + len(new_keys) > self.n_props:
+            raise KeyError(
+                f"property key capacity {self.n_props} exhausted")
+        uniq = {json.dumps(v, sort_keys=True) for v in values
+                if v is not None}
+        uniq -= set(self._prop_values._ids)
+        if len(self._prop_values) + len(uniq) > (1 << PROP_HANDLE_BITS):
+            raise KeyError("property value table exhausted")
+        for k in new_keys:
+            self._prop_plane(k)
 
     def release_props(self, minted: list) -> None:
         """Undo ``reserve_props`` after a post-admission nack. Sound only
@@ -389,16 +478,28 @@ class TensorStringStore(StringOpInterner):
             ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")))
 
     def apply_planes(self, rows, kind, a0, a1, seq_base, client_id, ref_seq,
-                     text: str, min_seq=None) -> None:
+                     text: str = "", min_seq=None, texts=None, tidx=None,
+                     props=None) -> None:
         """Columnar apply: dense (R, O) already-sequenced op planes for the
         subset of doc rows ``rows`` (R,) — the ingest hot path (no per-op
         Python objects anywhere). Ops per doc apply in column order (the
         sequencer's per-doc total order); NOOP slots (nacked ops) are
         skipped and consumed no seq, so per-op seqs are reconstructed ON
         DEVICE from the per-row ``seq_base`` (the doc's seq before the
-        batch). Insert payload is the broadcast ``text`` (every insert
-        inserts the same run — the typing-storm/stress shape; per-op
-        payloads go through ``apply_messages``); insert a1 is derived.
+        batch).
+
+        Payloads: either the broadcast ``text`` (every insert inserts the
+        same run — the typing-storm shape) or per-op payloads via
+        ``texts`` (a payload table) + ``tidx`` ((R, O) int32 indices into
+        it) — the distinct-payload shape real text produces. Insert a1 is
+        derived from the payload either way.
+
+        Annotates (kind == STR_ANNOTATE) are admitted when ``props`` (a
+        table of SINGLE-key {key: value} dicts, indexed by ``tidx``) is
+        given: one columnar slot = one (key, value) range annotate =
+        one sequence number. Multi-key annotates and insert-with-props
+        expand to several same-seq records and must go through
+        ``apply_messages``.
 
         ``min_seq`` (n_docs,) fuses zamboni into the same dispatch (the
         apply+compact single-HBM-round-trip configuration); if any doc in
@@ -418,8 +519,39 @@ class TensorStringStore(StringOpInterner):
                 "the message path (anchor slides are per-message)")
         kind = np.asarray(kind, np.int32)
         ins = kind == int(OpKind.STR_INSERT)
-        handle = self._payload(_TEXT, text)
-        a1 = np.where(ins, len(text), np.asarray(a1, np.int32))
+        ann = kind == int(OpKind.STR_ANNOTATE)
+        if ann.any() and props is None:
+            raise ValueError("annotate slots require the props table")
+        rich = not (texts is None and props is None)
+        if not rich:
+            # broadcast payload: a2 is one scalar handle
+            a2_np = np.array([self._payload(_TEXT, text)], np.int32)
+            a1 = np.where(ins, len(text), np.asarray(a1, np.int32))
+        else:
+            a2_np = np.zeros((R, O), np.int32)
+            tidx = np.asarray(tidx, np.int32)
+            a1 = np.asarray(a1, np.int32)
+            if texts is not None:
+                handles_tab = np.fromiter(
+                    (self._payload(_TEXT, t) for t in texts), np.int32,
+                    count=len(texts))
+                lens_tab = np.fromiter(map(len, texts), np.int32,
+                                       count=len(texts))
+                a2_np[ins] = handles_tab[tidx[ins]]
+                a1 = np.where(ins, lens_tab.take(tidx, mode="clip"), a1)
+            elif ins.any():
+                h = self._payload(_TEXT, text)
+                a2_np[ins] = h
+                a1 = np.where(ins, len(text), a1)
+            if props is not None and ann.any():
+                packed_tab = np.empty((len(props),), np.int32)
+                for j, p in enumerate(props):
+                    (key, value), = p.items()  # single-key by contract
+                    self._has_props = True
+                    packed_tab[j] = (self._prop_plane(key)
+                                     << PROP_HANDLE_BITS) \
+                        | self._prop_handle(value)
+                a2_np[ann] = packed_tab[tidx[ann]]
 
         # vectorized client interning: one dict hit per UNIQUE (row, client)
         # pair, not per op — packed into one int64 key (np.unique on a 1-D
@@ -437,28 +569,71 @@ class TensorStringStore(StringOpInterner):
                  for k in uniq], np.int32)
             cidx[valid] = lut[inv]
 
-        # pack narrow: host→device bytes dominate columnar ingest over a
-        # tunnel-attached device (device upcasts; see _columnar_apply_jit)
+        # word-pack EVERYTHING into one int32 buffer: over a
+        # tunnel-attached device each transfer pays the link round-trip,
+        # so the whole batch (planes + rows + seq bases + fused min_seq)
+        # rides ONE host→device copy at ~8 B/op (see _columnar_unpack_jit)
+        def seg_u8(arr):
+            b = np.ascontiguousarray(arr, np.uint8).reshape(-1)
+            if len(b) % 4:
+                b = np.concatenate([b, np.zeros((-len(b)) % 4, np.uint8)])
+            return b.view("<i4")
+
+        def seg_u16(arr):
+            b = np.ascontiguousarray(arr, "<u2").reshape(-1)
+            if len(b) % 2:
+                b = np.concatenate([b, np.zeros(1, "<u2")])
+            return b.view("<i4")
+
         a0 = np.asarray(a0, np.int32)
         narrow = int(a0.max(initial=0)) < 32767 and \
             int(a1.max(initial=0)) < 32767
-        pos_t = np.int16 if narrow else np.int32
+        seg_pos = (lambda a: np.ascontiguousarray(a, "<i4").reshape(-1)) \
+            if not narrow else seg_u16
+        seq_base = np.asarray(seq_base, np.int32)
+        seq = seq_base[:, None] + np.cumsum(valid, axis=1, dtype=np.int32)
+        lag = np.maximum(seq - np.asarray(ref_seq, np.int32), 1)
+        ref_wide = bool((lag > 65535).any())
         use_pallas, tile, interpret = self._pallas_choice()
         scatter_rows = not (R == self.n_docs
                             and np.array_equal(rows, np.arange(R)))
         fuse = min_seq is not None and not any(map(bool, self._intervals))
-        ms = jnp.asarray(np.asarray(min_seq, np.int32)) if fuse \
-            else jnp.zeros((1,), jnp.int32)
-        self.state = _columnar_apply_jit(
-            self.state, jnp.asarray(rows),
-            jnp.asarray(kind.astype(np.int8)),
-            jnp.asarray(a0.astype(pos_t)), jnp.asarray(a1.astype(pos_t)),
-            jnp.asarray(np.asarray(seq_base, np.int32)),
-            jnp.asarray(cidx.astype(np.int8)),
-            jnp.asarray(np.asarray(ref_seq, np.int32)),
-            jnp.int32(handle), ms, use_pallas=use_pallas, tile=tile,
+        ms = np.asarray(min_seq, np.int32) if fuse \
+            else np.zeros((1,), np.int32)
+        # tightest profile first: 5 B/op when spans, lags and client
+        # indexes all fit a byte (the live-collaboration common case —
+        # see _columnar_unpack_jit on why wire bytes are the ceiling)
+        span = np.where(ins, a1, a1 - a0)
+        compact8 = bool(
+            narrow and not ref_wide
+            and int(lag.max(initial=0)) < 256
+            and int(span.max(initial=0)) < 256
+            and int(span.min(initial=0)) >= 0
+            and int(cidx.max(initial=0)) < 64
+            and np.isin(kind, (0, 1, 2, 12)).all())
+        if compact8:
+            kc = np.where(kind == int(OpKind.NOOP), 3, kind) | (cidx << 2)
+            head = [seg_u8(kc), seg_u16(a0), seg_u8(span), seg_u8(lag)]
+        elif ref_wide:
+            head = [seg_u8(kind), seg_u8(cidx), seg_pos(a0), seg_pos(a1),
+                    np.ascontiguousarray(ref_seq, "<i4").reshape(-1)]
+        else:  # ship the (u16) lag; device reconstructs ref = seq - lag
+            head = [seg_u8(kind), seg_u8(cidx), seg_pos(a0), seg_pos(a1),
+                    seg_u16(lag)]
+        buf = np.concatenate(head + [
+            np.ascontiguousarray(a2_np, "<i4").reshape(-1),
+            seq_base.astype("<i4"),
+            rows.astype("<i4"),
+            ms.astype("<i4"),
+        ])
+        planes, ms_dev = _columnar_unpack_jit(
+            jnp.asarray(buf), R=R, O=O,
+            pos_wide=not narrow, ref_wide=ref_wide, rich=rich,
+            n_docs=self.n_docs, fuse_compact=fuse,
+            scatter_rows=scatter_rows, compact8=compact8)
+        self.state = _columnar_merge_jit(
+            self.state, planes, ms_dev, use_pallas=use_pallas, tile=tile,
             interpret=interpret, with_props=self._has_props,
-            scatter_rows=scatter_rows, n_docs=self.n_docs,
             fuse_compact=fuse)
         if min_seq is not None and not fuse:
             self.compact(np.asarray(min_seq))
