@@ -396,6 +396,8 @@ class TensorStringStore(StringOpInterner):
         self._intervals: List[Dict[str, tuple]] = [dict()
                                                    for _ in range(n_docs)]
         self._interval_counter = 0
+        #: wire profile of the last columnar batch (None before the first)
+        self.last_profile: Optional[tuple] = None
         # highest collaboration-window floor seen per doc (anchor slides
         # trigger at its advances, matching the oracle's zamboni timing)
         self._iv_min_seq = np.zeros((self.n_docs,), np.int64)
@@ -586,8 +588,12 @@ class TensorStringStore(StringOpInterner):
             return b.view("<i4")
 
         a0 = np.asarray(a0, np.int32)
+        # unsigned u16 packing would alias a (malformed) negative position
+        # to ~65535 — minima force such inputs onto the sign-preserving
+        # wide path, where they behave exactly like the per-op path
         narrow = int(a0.max(initial=0)) < 32767 and \
-            int(a1.max(initial=0)) < 32767
+            int(a1.max(initial=0)) < 32767 and \
+            int(a0.min(initial=0)) >= 0 and int(a1.min(initial=0)) >= 0
         seg_pos = (lambda a: np.ascontiguousarray(a, "<i4").reshape(-1)) \
             if not narrow else seg_u16
         seq_base = np.asarray(seq_base, np.int32)
@@ -611,6 +617,13 @@ class TensorStringStore(StringOpInterner):
             and int(span.min(initial=0)) >= 0
             and int(cidx.max(initial=0)) < 64
             and np.isin(kind, (0, 1, 2, 12)).all())
+        # observability: which wire profile this batch took (head encoding,
+        # position width, payload form) — tests pin each branch by name
+        self.last_profile = (
+            "compact8" if compact8 else
+            "ref_wide" if ref_wide else "lag16",
+            "pos16" if narrow else "pos32",
+            "rich" if rich else "broadcast")
         if compact8:
             kc = np.where(kind == int(OpKind.NOOP), 3, kind) | (cidx << 2)
             head = [seg_u8(kc), seg_u16(a0), seg_u8(span), seg_u8(lag)]
@@ -1028,6 +1041,7 @@ class TensorStringStore(StringOpInterner):
             for per_doc in snap.get("intervals",
                                     [{} for _ in range(n_docs)])]
         store._interval_counter = snap.get("interval_counter", 0)
+        store.last_profile = None
         store._iv_min_seq = np.asarray(
             snap.get("iv_min_seq", [0] * n_docs), np.int64)
         store._iv_tombs = [[] for _ in range(n_docs)]

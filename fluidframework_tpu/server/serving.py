@@ -142,6 +142,18 @@ class ServingEngineBase:
         # per-lambda observability (SURVEY.md §5.5: op rate, nacks by
         # reason, flush batch sizes, flush latency percentiles)
         self.metrics = MetricsCollector()
+        # set when the device state may be AHEAD of the durable log (a
+        # log append failed after the merge was dispatched): every ingest
+        # and summary refuses until the engine is rebuilt via load() —
+        # summarizing now would durably persist never-logged ops
+        self._poisoned: Optional[str] = None
+
+    def _check_poisoned(self) -> None:
+        if self._poisoned:
+            raise RuntimeError(
+                f"engine poisoned ({self._poisoned}): device state may be "
+                "ahead of the durable log; rebuild via load() from the "
+                "latest summary + log")
 
     def enable_attribution(self) -> None:
         """Record (client, timestamp) per sequenced op for serving-side
@@ -197,6 +209,7 @@ class ServingEngineBase:
         overflows are nacked BEFORE sequencing/logging: an acked-and-logged
         op the flush path cannot apply would poison the engine AND its
         recovery replay (the log is replayed through the same path)."""
+        self._check_poisoned()
         if not self._valid_op(contents):
             return self._nacked(Nack(doc_id, client_id, client_seq,
                                      NackReason.MALFORMED))
@@ -286,6 +299,7 @@ class ServingEngineBase:
     # calls _restore_base() then _replay_tail().
 
     def _base_summary(self) -> dict:
+        self._check_poisoned()
         out = {
             "deli": self.deli.checkpoint(),
             "log_offsets": [self.log.size(p)
@@ -538,6 +552,7 @@ class StringServingEngine(ServingEngineBase):
         apply, so wall time per batch is max(host, device), not the sum.
         Crash-consistency is unaffected: recovery rebuilds from summary +
         log only, and the call returns (acks) after the log append."""
+        self._check_poisoned()
         raw = getattr(self.deli, "raw", None)
         if raw is None:
             raise RuntimeError("columnar ingest requires sequencer='native'")
@@ -567,6 +582,26 @@ class StringServingEngine(ServingEngineBase):
             raise ValueError("columnar planes must be dense "
                              "insert/remove" +
                              ("/annotate" if props is not None else ""))
+        # tidx must be validated BEFORE sequencing: a negative index would
+        # silently wrap (numpy fancy indexing) and apply/ack/log the WRONG
+        # payload; an out-of-range one would raise only after the native
+        # sequencer consumed seqs, leaving doc.seq ahead of the durable log
+        if tidx is not None:
+            tidx_arr = np.asarray(tidx, np.int32)
+            if tidx_arr.shape != kind.shape:
+                raise ValueError("tidx shape must match the op planes")
+            if (tidx_arr < 0).any():
+                raise ValueError("negative tidx in columnar batch")
+            ins_m = kind == int(OpKind.STR_INSERT)
+            if texts is not None and ins_m.any() and \
+                    int(tidx_arr[ins_m].max()) >= len(texts):
+                raise ValueError("insert tidx beyond the payload table")
+            ann_m = kind == int(OpKind.STR_ANNOTATE)
+            if props is not None and ann_m.any() and \
+                    int(tidx_arr[ann_m].max()) >= len(props):
+                raise ValueError("annotate tidx beyond the props table")
+        elif texts is not None or props is not None:
+            raise ValueError("payload/props tables require the tidx plane")
 
         if (self._row_handle[rows] < 0).any():  # fill handle cache once
             for r in rows:
@@ -583,6 +618,12 @@ class StringServingEngine(ServingEngineBase):
         handles = np.repeat(self._row_handle[rows], O)
         out_seq, out_min = raw.sequence_batch_rows(
             handles, flat(client), flat(client_seq), flat(ref_seq))
+        # poison-by-default from here to the end of the log append: ANY
+        # failure in between (device apply, packing, a partition append)
+        # leaves doc.seq — and possibly device state — ahead of the
+        # durable log; a summary taken then would durably persist ops the
+        # log never recorded. Cleared only when the append loop completes.
+        self._poisoned = "columnar batch failed after sequencing"
         nacked = out_seq < 0
         n_ok = int((~nacked).sum())
         self.metrics.inc("ops_ingested", n_ok)
@@ -600,6 +641,12 @@ class StringServingEngine(ServingEngineBase):
         n_valid = valid_rs.sum(axis=1)
         seq_base = (np.max(np.where(valid_rs, seq_rs, 0), axis=1)
                     - n_valid).astype(np.int32)
+        # window-floor tracking for zamboni: fold this batch's MSN advance
+        # in BEFORE building the fused compaction floor, so a compaction-due
+        # batch zambonis at the post-batch floor (not one batch stale)
+        last_min = out_min.reshape(R, O)[:, -1]
+        for i, r in enumerate(rows):
+            self._min_seq[self._row_doc_id[r]] = int(last_min[i])
         compact_due = self._flushes_since_compact + 1 >= self.compact_every
         ms_arr = None
         if compact_due:
@@ -646,11 +693,7 @@ class StringServingEngine(ServingEngineBase):
                 ids, row_sorted[sl], *(g[sl] for g in gathered),
                 text=text, timestamp=ts, texts=texts, props=props,
                 tidx=None if tidx_flat is None else tidx_flat[sl]))
-
-        # window-floor tracking for zamboni (last MSN per doc in the batch)
-        last_min = out_min.reshape(R, O)[:, -1]
-        for i, r in enumerate(rows):
-            self._min_seq[self._row_doc_id[r]] = int(last_min[i])
+        self._poisoned = None  # sequence → merge → log completed
 
         if self._attributors is not None:
             ok = ~nacked

@@ -405,3 +405,190 @@ def test_columnar_annotate_without_props_table_rejected():
                             (R, 1), np.int32), z, kind, z, z,
                         texts=["t"], tidx=z,
                         props=[{"a": 1, "b": 2}])
+
+
+# ------------------------- ingest-side tidx validation (ADVICE r3 medium)
+
+
+def test_columnar_rejects_bad_tidx_before_sequencing():
+    """A negative tidx would wrap to the wrong payload; an out-of-range one
+    would raise AFTER the native sequencer consumed seqs (doc.seq ahead of
+    the durable log). Both must be rejected before sequencing."""
+    R, O = 2, 4
+    a, _, docs, rows = _engines(R, O)
+    client = np.ones((R, O), np.int32)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32), (R, O))
+    ref = np.zeros((R, O), np.int32)
+    kind = np.zeros((R, O), np.int32)  # inserts
+    z = np.zeros((R, O), np.int32)
+    texts = ["aa", "bb"]
+    seq_before = {d: a.deli.doc_seq(d) for d in docs}
+
+    neg = z.copy()
+    neg[1, 2] = -1
+    with pytest.raises(ValueError, match="negative tidx"):
+        a.ingest_planes(rows, client, cseq, ref, kind, z, z,
+                        texts=texts, tidx=neg)
+    big = z.copy()
+    big[0, 1] = 2  # == len(texts)
+    with pytest.raises(ValueError, match="payload table"):
+        a.ingest_planes(rows, client, cseq, ref, kind, z, z,
+                        texts=texts, tidx=big)
+    with pytest.raises(ValueError, match="require the tidx"):
+        a.ingest_planes(rows, client, cseq, ref, kind, z, z, texts=texts)
+    ann = np.full((R, O), int(OpKind.STR_ANNOTATE), np.int32)
+    span = np.broadcast_to(np.array([1], np.int32), (R, O))
+    bigp = z.copy()
+    bigp[0, 0] = 5  # beyond the 1-entry props table
+    with pytest.raises(ValueError, match="props table"):
+        a.ingest_planes(rows, client, cseq, ref, ann, z, span,
+                        texts=texts, tidx=bigp, props=[{"b": 1}])
+    # nothing was sequenced or logged by any rejected batch
+    for d in docs:
+        assert a.deli.doc_seq(d) == seq_before[d]
+    assert sum(a.log.size(p) for p in range(a.log.n_partitions)) == len(docs)
+
+
+# ----------------------- append-failure poisoning (VERDICT r3 weak #4)
+
+
+class _FailingLog(PartitionedLog):
+    """Durable log whose append starts failing on command (full disk)."""
+
+    def __init__(self, n_partitions):
+        super().__init__(n_partitions)
+        self.fail = False
+        self._appends_until_fail = 0
+
+    def arm(self, appends_until_fail: int) -> None:
+        self.fail = True
+        self._appends_until_fail = appends_until_fail
+
+    def append(self, p, rec):
+        if self.fail:
+            if self._appends_until_fail <= 0:
+                raise IOError("disk full")
+            self._appends_until_fail -= 1
+        super().append(p, rec)
+
+
+def test_append_failure_poisons_engine_and_blocks_summary():
+    """If the durable-log append fails mid-batch AFTER the device merge was
+    dispatched, the engine must refuse further ingest and summaries: a
+    summary taken now would durably persist ops the log never recorded."""
+    R, O = 4, 8
+    log = _FailingLog(4)
+    eng = StringServingEngine(n_docs=R, capacity=256, batch_window=10 ** 9,
+                              sequencer="native", log=log, n_partitions=4)
+    docs = [f"doc-{i}" for i in range(R)]
+    for d in docs:
+        eng.connect(d, 1)
+    rows = np.array([eng.doc_row(d) for d in docs], np.int32)
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    batches = _batches(R, O, 2)
+    kind, a0, a1, cseq = batches[0]
+    eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    good_summary = eng.summarize()
+    good_text = {d: eng.read_text(d) for d in docs}
+
+    sizes_before = [log.size(p) for p in range(4)]
+    log.arm(1)  # the batch's second partition append explodes
+    kind, a0, a1, cseq = batches[1]
+    with pytest.raises(IOError):
+        eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+
+    # poisoned: no more ingest (either path), no summary — summarizing now
+    # would durably persist the device-applied-but-unlogged ops
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.submit(docs[0], 1, 99, 0,
+                   {"mt": "insert", "kind": 0, "pos": 0, "text": "x"})
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.summarize()
+
+    # recovery from the pre-failure summary + log: ops whose partition
+    # append SUCCEEDED are durably sequenced and legitimately replay
+    # (unacked-but-logged); ops whose append failed must be GONE — the
+    # device had applied them, but the rebuilt engine never sees them
+    log.fail = False
+    logged_parts = {p for p in range(4) if log.size(p) > sizes_before[p]}
+    assert logged_parts and logged_parts != set(range(4))  # genuine partial
+    revived = StringServingEngine.load(good_summary, log)
+    from fluidframework_tpu.server.oplog import partition_of
+    unlogged = [d for d in docs if partition_of(d, 4) not in logged_parts]
+    logged = [d for d in docs if partition_of(d, 4) in logged_parts]
+    assert unlogged
+    for d in unlogged:
+        assert revived.read_text(d) == good_text[d], d
+    # parity for the partially-logged docs: a reference engine fed batch 1
+    # plus batch 2 only for those docs must agree
+    ref_eng = StringServingEngine(n_docs=R, capacity=256,
+                                  batch_window=10 ** 9)
+    for d in docs:
+        ref_eng.connect(d, 1)
+    k1, x0, x1, c1 = batches[0]
+    for b_kind, b_a0, b_a1, b_cseq, only in (
+            (k1, x0, x1, c1, None), (kind, a0, a1, cseq, logged)):
+        for di, d in enumerate(docs):
+            if only is not None and d not in only:
+                continue
+            for o in range(O):
+                if b_kind[di, o] == OpKind.STR_INSERT:
+                    c = {"mt": "insert", "kind": 0,
+                         "pos": int(b_a0[di, o]), "text": TEXT}
+                else:
+                    c = {"mt": "remove", "start": int(b_a0[di, o]),
+                         "end": int(b_a1[di, o])}
+                _, nack = ref_eng.submit(d, 1, int(b_cseq[di, o]), 0, c)
+                assert nack is None
+    for d in docs:
+        assert revived.read_text(d) == ref_eng.read_text(d), d
+
+    # the revived engine serves and sequences past the replayed tail
+    nxt = 2 * O + 1 if docs[0] in logged else O + 1
+    before = revived.read_text(docs[0])
+    msg, nack = revived.submit(
+        docs[0], 1, nxt, 0,
+        {"mt": "insert", "kind": 0, "pos": 0, "text": "Z"})
+    assert nack is None
+    assert revived.read_text(docs[0]) == "Z" + before
+
+
+def test_props_without_tidx_rejected_before_sequencing():
+    """Review r4 finding: annotate batch with props but tidx=None must be
+    rejected up front, not explode in apply_planes after seqs were spent."""
+    R, O = 2, 4
+    a, _, docs, rows = _engines(R, O)
+    ann = np.full((R, O), int(OpKind.STR_ANNOTATE), np.int32)
+    z = np.zeros((R, O), np.int32)
+    span = np.ones((R, O), np.int32)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32), (R, O))
+    seq_before = {d: a.deli.doc_seq(d) for d in docs}
+    with pytest.raises(ValueError, match="tidx"):
+        a.ingest_planes(rows, np.ones((R, O), np.int32), cseq, z,
+                        ann, z, span, props=[{"b": 1}])
+    for d in docs:
+        assert a.deli.doc_seq(d) == seq_before[d]
+
+
+def test_post_sequencing_failure_before_append_poisons():
+    """Review r4 finding: a failure AFTER the native sequencer consumed
+    seqs but BEFORE the log append (e.g. the device store refusing the
+    batch) must poison — doc.seq is ahead of the durable log."""
+    R, O = 2, 4
+    a, _, docs, rows = _engines(R, O)
+    # an interval on a targeted doc makes store.apply_planes raise after
+    # sequencing succeeded
+    a.submit(docs[0], 1, 1, 0,
+             {"mt": "insert", "kind": 0, "pos": 0, "text": "hello"})
+    a.store.add_interval(a.doc_row(docs[0]), 0, 3)
+    kind = np.zeros((R, O), np.int32)
+    z = np.zeros((R, O), np.int32)
+    cseq = np.broadcast_to(np.arange(2, O + 2, dtype=np.int32), (R, O))
+    with pytest.raises(ValueError, match="intervals"):
+        a.ingest_planes(rows, np.ones((R, O), np.int32), cseq, z,
+                        kind, z, z, TEXT)
+    with pytest.raises(RuntimeError, match="poisoned"):
+        a.summarize()
