@@ -1,0 +1,147 @@
+"""Doc-axis sharding of the serving store: the product's multi-chip path.
+
+Reference counterpart: Routerlicious scales by partitioning DOCUMENTS
+across Kafka partitions and lambda instances (SURVEY.md §2.13/§2.14) —
+documents are independent, so the TPU-native mapping is a 1-D ``docs``
+mesh axis with every chip owning ``n_docs / n_chips`` rows of the
+serving store's planes.
+
+The merge kernel is per-doc math (vmap over docs, scan over ops, rolls
+along the slot axis), so the sharded apply is expressed as a
+``shard_map`` whose body is the SAME ``apply_string_batch`` /
+``apply_string_batch_pallas`` the single-chip path runs — by
+construction there is **zero cross-chip communication** on the apply
+path (the dryrun asserts this from the compiled HLO). What does cross
+chips: the host→device op buffer (5-8 B/op, broadcast), rare row
+writes (overflow re-upload), and per-doc reads — all off the hot path.
+
+``parallel/replicated.py`` layers the REPLICA axis (redundant copies +
+digest agreement) on top; this module is the scale-out axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.merge_tree_kernel import (
+    StringState, apply_string_batch, compact_string_state,
+)
+from ..ops.pallas_string_kernel import apply_string_batch_pallas
+from .mesh import DOC_AXIS
+
+
+def make_doc_mesh(n_devices: Optional[int] = None) -> Mesh:
+    """1-D ``docs`` mesh: each device owns a contiguous block of doc rows."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(np.array(devices[:n]), (DOC_AXIS,))
+
+
+def doc_state_specs() -> StringState:
+    """PartitionSpecs of every StringState plane on a docs-only mesh."""
+    row = P(DOC_AXIS, None)
+    return StringState(
+        seq=row, client=row, removed_seq=row, removers=row, length=row,
+        handle_op=row, handle_off=row, prop_val=P(DOC_AXIS, None, None),
+        count=P(DOC_AXIS), overflow=P(DOC_AXIS),
+    )
+
+
+def shard_store_state(state: StringState, mesh: Mesh) -> StringState:
+    """Place a store's planes onto the mesh, doc-row sharded."""
+    if state.seq.shape[0] % mesh.devices.size != 0:
+        raise ValueError(f"n_docs {state.seq.shape[0]} not divisible by "
+                         f"mesh size {mesh.devices.size}")
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        state, doc_state_specs())
+
+
+# jitted sharded programs, cached per (mesh, static flags) — the serving
+# engine dispatches thousands of batches through the same few programs
+_CACHE: dict = {}
+
+
+def sharded_merge(mesh: Mesh, use_pallas: bool, tile: int, interpret: bool,
+                  with_props: bool, fuse_compact: bool):
+    """The sharded columnar/message merge: (state, 7×(D,O) planes[, min_seq])
+    → state. Body = the single-chip kernel on each shard's doc block."""
+    key = ("merge", mesh, use_pallas, tile, interpret, with_props,
+           fuse_compact)
+    if key not in _CACHE:
+        specs = doc_state_specs()
+        planes_spec = (P(DOC_AXIS, None),) * 7
+
+        if fuse_compact:
+            @functools.partial(jax.jit, donate_argnums=0)
+            def fn(state, planes, ms):
+                def body(state, planes, ms):
+                    if use_pallas:
+                        return apply_string_batch_pallas(
+                            state, *planes, tile=tile, interpret=interpret,
+                            min_seq=ms, with_props=with_props)
+                    out = apply_string_batch(state, *planes,
+                                             with_props=with_props)
+                    return compact_string_state(out, ms, with_props)
+                # check_vma=False: the Pallas body's output aval carries
+                # no vma annotation (same setting as parallel/replicated.py)
+                return jax.shard_map(
+                    body, mesh=mesh,
+                    in_specs=(specs, planes_spec, P(DOC_AXIS)),
+                    out_specs=specs, check_vma=False)(state, planes, ms)
+        else:
+            @functools.partial(jax.jit, donate_argnums=0)
+            def fn(state, planes):
+                def body(state, planes):
+                    if use_pallas:
+                        return apply_string_batch_pallas(
+                            state, *planes, tile=tile, interpret=interpret,
+                            with_props=with_props)
+                    return apply_string_batch(state, *planes,
+                                              with_props=with_props)
+                return jax.shard_map(
+                    body, mesh=mesh, in_specs=(specs, planes_spec),
+                    out_specs=specs, check_vma=False)(state, planes)
+        _CACHE[key] = fn
+    return _CACHE[key]
+
+
+def sharded_compact(mesh: Mesh, with_props: bool):
+    """Sharded zamboni: (state, (D,) min_seq) → state, per-shard compact."""
+    key = ("compact", mesh, with_props)
+    if key not in _CACHE:
+        specs = doc_state_specs()
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def fn(state, ms):
+            return jax.shard_map(
+                lambda s, m: compact_string_state(s, m, with_props),
+                mesh=mesh, in_specs=(specs, P(DOC_AXIS)),
+                out_specs=specs, check_vma=False)(state, ms)
+        _CACHE[key] = fn
+    return _CACHE[key]
+
+
+def assert_collective_free(mesh: Mesh, n_docs: int, capacity: int,
+                           n_ops: int) -> str:
+    """Compile the sharded merge at the given shape and prove the apply
+    path needs NO cross-chip communication: the optimized HLO must contain
+    zero collective ops. Returns the (empty) list rendered as evidence."""
+    import jax.numpy as jnp
+    state = shard_store_state(StringState.create(n_docs, capacity), mesh)
+    planes = tuple(jnp.zeros((n_docs, n_ops), jnp.int32) for _ in range(7))
+    ms = jnp.zeros((n_docs,), jnp.int32)
+    fn = sharded_merge(mesh, use_pallas=False, tile=8, interpret=False,
+                       with_props=False, fuse_compact=True)
+    hlo = fn.lower(state, planes, ms).compile().as_text()
+    bad = [op for op in ("all-reduce", "all-gather", "all-to-all",
+                         "collective-permute", "reduce-scatter",
+                         "collective-broadcast")
+           if op in hlo]
+    assert not bad, f"sharded merge HLO contains collectives: {bad}"
+    return "collective-free"

@@ -357,7 +357,11 @@ class StringServingEngine(ServingEngineBase):
                  log: Optional[PartitionedLog] = None,
                  store: Optional[TensorStringStore] = None,
                  mega_docs: int = 0, mega_capacity_per_shard: int = 256,
-                 mega_store=None, sequencer: str = "python"):
+                 mega_store=None, sequencer: str = "python", mesh=None):
+        """``mesh``: a 1-D ``docs`` device mesh (``parallel.sharded.
+        make_doc_mesh``) shards the store's planes by doc row across chips
+        — the scale-out configuration of SURVEY.md §2.14; every flush then
+        runs as a collective-free shard_map of the same kernels."""
         super().__init__(batch_window, n_partitions, compact_every, log,
                          sequencer=sequencer)
         # columnar-ingest row caches (doc id / native handle / partition by
@@ -365,8 +369,14 @@ class StringServingEngine(ServingEngineBase):
         self._row_doc_id: List[Optional[str]] = [None] * n_docs
         self._row_handle = np.full(n_docs, -1, np.int32)
         self._row_part = np.zeros(n_docs, np.int32)
+        if store is not None and mesh is not None \
+                and getattr(store, "mesh", None) is not mesh:
+            raise ValueError("mesh given with a store that is not sharded "
+                             "over it; build the store with mesh= or "
+                             "restore(snap, mesh=...)")
         self.store = store if store is not None \
-            else TensorStringStore(n_docs, capacity, n_props)
+            else TensorStringStore(n_docs, capacity, n_props, mesh=mesh)
+        self.mesh = getattr(self.store, "mesh", mesh)
         # mega tier: documents too long for one chip's slot budget are
         # served by the segment-axis-sharded store (declare with mark_mega
         # BEFORE the doc's first op; capacity here is per shard per doc)
@@ -947,12 +957,13 @@ class StringServingEngine(ServingEngineBase):
         return summary
 
     @classmethod
-    def load(cls, summary: dict, log: PartitionedLog,
+    def load(cls, summary: dict, log: PartitionedLog, mesh=None,
              **kwargs) -> "StringServingEngine":
         """Resume from a summary + the durable log: restore the device
         state, restore the sequencer, then replay the log tail through the
-        same apply kernels — the single recovery primitive."""
-        store = TensorStringStore.restore(summary["store"])
+        same apply kernels — the single recovery primitive. ``mesh``
+        re-shards the restored planes (recovery onto a fresh mesh)."""
+        store = TensorStringStore.restore(summary["store"], mesh=mesh)
         mega = None
         if summary.get("mega_store") is not None:
             from ..ops.megadoc_store import MegaDocStringStore
