@@ -137,6 +137,16 @@ class ValueInterner:
         """Values in handle order (element 0 is the reserved None)."""
         return list(self._values)
 
+    def export_from(self, base: int) -> list:
+        """Values appended since ``base`` (incremental-summary delta;
+        the table is append-only)."""
+        return list(self._values[base:])
+
+    def extend_from(self, values: list) -> None:
+        """Re-append an ``export_from`` delta (restore path)."""
+        for v in values:
+            self.handle(v)
+
     @classmethod
     def restore(cls, values: list) -> "ValueInterner":
         it = cls()

@@ -15,6 +15,7 @@ import dataclasses
 import functools
 import heapq
 import json
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -31,6 +32,35 @@ from .schema import OpKind, ValueInterner
 
 _TEXT = 0
 _MARKER = 1
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _write_rows_jit(state, rows, seq, client, removed_seq, removers, length,
+                    handle_op, handle_off, prop_val, count, overflow):
+    """Batched overwrite of a subset of doc rows (incremental-summary
+    restore): one scatter per plane, one dispatch total."""
+    return StringState(
+        seq=state.seq.at[rows].set(seq),
+        client=state.client.at[rows].set(client),
+        removed_seq=state.removed_seq.at[rows].set(removed_seq),
+        removers=state.removers.at[rows].set(removers),
+        length=state.length.at[rows].set(length),
+        handle_op=state.handle_op.at[rows].set(handle_op),
+        handle_off=state.handle_off.at[rows].set(handle_off),
+        prop_val=state.prop_val.at[rows].set(prop_val),
+        count=state.count.at[rows].set(count),
+        overflow=state.overflow.at[rows].set(overflow),
+    )
+
+
+@jax.jit
+def _gather_rows_jit(state, rows):
+    """(plane subsets for a row list) in ONE device→host round-trip —
+    the incremental-summary gather (dirty rows only)."""
+    return (state.seq[rows], state.client[rows], state.removed_seq[rows],
+            state.removers[rows], state.length[rows],
+            state.handle_op[rows], state.handle_off[rows],
+            state.prop_val[rows], state.count[rows], state.overflow[rows])
 
 
 @functools.partial(jax.jit, donate_argnums=0)
@@ -530,6 +560,7 @@ class TensorStringStore(StringOpInterner):
 
         Docs holding intervals must use ``apply_messages`` (anchor slides
         need per-message window tracking)."""
+        _t0 = time.perf_counter()
         rows = np.ascontiguousarray(rows, np.int32)
         R, O = kind.shape
         if len(np.unique(rows)) != R:
@@ -575,15 +606,26 @@ class TensorStringStore(StringOpInterner):
                         | self._prop_handle(value)
                 a2_np[ann] = packed_tab[tidx[ann]]
 
-        # vectorized client interning: one dict hit per UNIQUE (row, client)
-        # pair, not per op — packed into one int64 key (np.unique on a 1-D
-        # int key is ~10× faster than axis=0 row dedup); nacked/NOOP slots
-        # never mint an index
+        # vectorized client interning. Fast path: one writer per doc row in
+        # this batch (the common live-collaboration window) — R dict hits,
+        # no materialized (R·O) key array. General path: one dict hit per
+        # UNIQUE (row, client) pair via a packed int64 key (np.unique on a
+        # 1-D int key is ~10× faster than axis=0 row dedup); nacked/NOOP
+        # slots never mint an index there.
         valid = kind != int(OpKind.NOOP)
         cidx = np.zeros((R, O), np.int32)
-        if valid.any():
+        cid = np.asarray(client_id, np.int32)
+        if (cid == cid[:, :1]).all():
+            # mint only for rows with at least one acked op (an all-NOOP
+            # row must not consume one of the doc's MAX_CLIENTS slots —
+            # and must match what a log rebuild would intern)
+            lut = np.zeros(R, np.int32)
+            for i in np.flatnonzero(valid.any(axis=1)):
+                lut[i] = self._client(int(rows[i]), int(cid[i, 0]))
+            cidx[:] = lut[:, None]
+        elif valid.any():
             rr = np.broadcast_to(rows[:, None], (R, O))[valid]
-            cc = np.asarray(client_id, np.int64)[valid]
+            cc = cid.astype(np.int64)[valid]
             key = (rr.astype(np.int64) << 32) | (cc & 0xFFFFFFFF)
             uniq, inv = np.unique(key, return_inverse=True)
             lut = np.array(
@@ -659,6 +701,7 @@ class TensorStringStore(StringOpInterner):
             rows.astype("<i4"),
             ms.astype("<i4"),
         ])
+        _t_pack = time.perf_counter()
         planes, ms_dev = _columnar_unpack_jit(
             jnp.asarray(buf), R=R, O=O,
             pos_wide=not narrow, ref_wide=ref_wide, rich=rich,
@@ -677,6 +720,14 @@ class TensorStringStore(StringOpInterner):
                 self.state, planes, ms_dev, use_pallas=use_pallas,
                 tile=tile, interpret=interpret,
                 with_props=self._has_props, fuse_compact=fuse)
+        #: host-packing vs device-dispatch wall per columnar apply — the
+        #: breakdown behind the serving throughput number (dispatches are
+        #: async; device time is measured by the caller's end sync)
+        _t_done = time.perf_counter()
+        self.last_apply_stats = {
+            "pack_ms": (_t_pack - _t0) * 1000,
+            "dispatch_ms": (_t_done - _t_pack) * 1000,
+        }
         if min_seq is not None and not fuse:
             self.compact(np.asarray(min_seq))
 
@@ -701,6 +752,15 @@ class TensorStringStore(StringOpInterner):
                 if smaller <= tile and local_docs % smaller == 0:
                     tile = smaller
                     break
+        # VMEM budget scales with tile×capacity (7 planes + temporaries
+        # ≈ 28 B per slot): T=128 at S=384 fits the 16M scoped limit,
+        # S=512 needs T=64 (measured OOM at 19.5M otherwise)
+        while (tile is not None and tile > 8
+               and tile * self.capacity * 28 > 14 * 1024 * 1024):
+            nxt = tile // 2
+            if local_docs % nxt != 0:
+                break
+            tile = nxt
         return use_pallas, (tile if tile is not None else 8), \
             (mode == "interpret")
 
@@ -1053,6 +1113,109 @@ class TensorStringStore(StringOpInterner):
             "interval_counter": self._interval_counter,
             "iv_min_seq": self._iv_min_seq.tolist(),
         }
+
+    def snapshot_rows(self, rows, payloads_base: int,
+                      prop_values_base: int) -> dict:
+        """Incremental snapshot: ONLY the given doc rows' planes (one
+        fused device→host gather) plus the append-only interner DELTAS
+        since the last summary (``payloads_base`` / ``prop_values_base``
+        are the table lengths recorded then). Clean rows are represented
+        by reference to the previous summary — the handle-reuse half of
+        SURVEY.md §2.16. Intervals ride in full (they mutate outside the
+        op stream, so cheap full inclusion beats tracking)."""
+        rows = np.ascontiguousarray(rows, np.int32)
+        if len(rows):
+            # pad the row list to a power of two (repeating row 0) so the
+            # gather jit compiles one program per BUCKET, not one per
+            # distinct dirty-row count
+            n = len(rows)
+            p2 = 1 << (n - 1).bit_length()
+            rows_p = np.concatenate(
+                [rows, np.full(p2 - n, rows[0], np.int32)])
+            g = [np.asarray(x)[:n] for x in
+                 _gather_rows_jit(self.state, jnp.asarray(rows_p))]
+            w = max(int(g[8].max()), 1)
+            planes = {k: g[i][:, :w].copy()
+                      for i, k in enumerate(self._SNAP_PLANES)}
+            counts, overflow = g[8].copy(), g[9].copy()
+        else:
+            planes = {k: np.zeros((0, 1), np.int32)
+                      for k in self._SNAP_PLANES}
+            counts = overflow = np.zeros((0,), np.int32)
+        return {
+            "rows": rows,
+            "planes": planes,
+            "count": counts,
+            "overflow": overflow,
+            "payloads_delta": list(self._payloads[payloads_base:]),
+            "client_idx": {int(r): dict(self._client_idx[int(r)])
+                           for r in rows},
+            "prop_planes": dict(self._prop_planes),
+            "prop_values_delta":
+                self._prop_values.export_from(prop_values_base),
+            "has_props": self._has_props,
+            "intervals": [{iid: [list(a) if a else None,
+                                 list(b) if b else None, props]
+                           for iid, (a, b, props) in per_doc.items()}
+                          for per_doc in self._intervals],
+            "interval_counter": self._interval_counter,
+            "iv_min_seq": self._iv_min_seq.tolist(),
+        }
+
+    def apply_row_snapshot(self, delta: dict) -> None:
+        """Fold one ``snapshot_rows`` delta into this (restored-base)
+        store: overwrite the dirty rows' device planes in one dispatch,
+        extend the append-only interner tables, replace interval state."""
+        self._payloads.extend(tuple(p) for p in delta["payloads_delta"])
+        self._prop_planes = dict(delta["prop_planes"])
+        self._prop_values.extend_from(delta["prop_values_delta"])
+        self._has_props = self._has_props or delta["has_props"]
+        rows = np.asarray(delta["rows"], np.int32)
+        if len(rows):
+            for r, m in delta["client_idx"].items():
+                self._client_idx[int(r)] = dict(m)
+            w = delta["planes"]["seq"].shape[1]
+            # power-of-two row bucket (repeat row 0 with its own values —
+            # a duplicate scatter of identical values is a no-op): one
+            # compiled scatter per bucket, not per dirty-row count
+            n = len(rows)
+            p2 = 1 << (n - 1).bit_length()
+            rows_p = np.concatenate(
+                [rows, np.full(p2 - n, rows[0], np.int32)])
+
+            def bucket(a):
+                return np.concatenate(
+                    [a, np.repeat(a[:1], p2 - n, axis=0)]) if p2 > n else a
+
+            def pad(a, fill=0):
+                out = np.full((p2, self.capacity) + a.shape[2:],
+                              fill, np.int32)
+                out[:n, :w] = a
+                out[n:] = out[:1]
+                return jnp.asarray(out)
+
+            prop = np.zeros((p2, self.capacity, self.n_props), np.int32)
+            if "prop_val" in delta["planes"]:
+                pv = delta["planes"]["prop_val"]
+                prop[:n, :pv.shape[1]] = pv
+                prop[n:] = prop[:1]
+            self.state = _write_rows_jit(
+                self.state, jnp.asarray(rows_p),
+                *(pad(delta["planes"][k],
+                      NOT_REMOVED if k == "removed_seq" else 0)
+                  for k in _PLANES),
+                jnp.asarray(prop), jnp.asarray(bucket(delta["count"])),
+                jnp.asarray(bucket(delta["overflow"])))
+        self._intervals = [
+            {iid: (tuple(a) if a else None, tuple(b) if b else None,
+                   dict(props))
+             for iid, (a, b, props) in per_doc.items()}
+            for per_doc in delta["intervals"]]
+        self._interval_counter = delta["interval_counter"]
+        self._iv_min_seq = np.asarray(delta["iv_min_seq"], np.int64)
+        for d in range(self.n_docs):
+            if self._intervals[d]:
+                self._seed_tombs(d)
 
     @classmethod
     def restore(cls, snap: dict, mesh=None) -> "TensorStringStore":

@@ -87,29 +87,53 @@ _COL_FIELDS = ("doc", "client", "client_seq", "ref_seq", "seq", "min_seq",
                "kind", "a0", "a1")
 
 
+def _plane_width(plane) -> int:
+    """Smallest signed byte width ∈ {1, 2, 4, 8} holding the plane."""
+    if plane.size == 0:
+        return 1
+    lo, hi = int(plane.min()), int(plane.max())
+    for w, bound in ((1, 1 << 7), (2, 1 << 15), (4, 1 << 31)):
+        if -bound <= lo and hi < bound:
+            return w
+    return 8
+
+
 def encode_columnar(rec) -> bytes:
+    """v3 frame (tag b"D"): each plane prefixed by ONE width byte and
+    stored at the smallest signed width that holds its values. The old
+    all-int64 framing cost 72 B/op — ~47 MB fwrite+fsync per 655k-op
+    batch, 5× the whole device apply; width coding brings a typical
+    batch to ~16 B/op."""
     import numpy as np
     doc_ids = json.dumps(rec.doc_ids).encode()
     text = rec.text.encode()
     n = len(rec.seq)
     parts = [_COL_HEADER.pack(n, float(rec.timestamp), len(doc_ids),
                               len(text)), doc_ids, text]
+
+    def plane_bytes(plane):
+        plane = np.asarray(plane)
+        assert plane.shape == (n,), "plane length mismatch"
+        w = _plane_width(plane)
+        return bytes([w]) + np.ascontiguousarray(
+            plane, dtype=f"<i{w}").tobytes()
+
     for f in _COL_FIELDS:
-        plane = np.ascontiguousarray(getattr(rec, f), dtype="<i8")
-        assert plane.shape == (n,), f"plane {f} length mismatch"
-        parts.append(plane.tobytes())
-    # v2 extras: per-op payload/annotate tables + the tidx plane. A record
-    # with none of them ends exactly after the 9 planes (v1-compatible).
+        parts.append(plane_bytes(getattr(rec, f)))
+    # extras: per-op payload/annotate tables + the tidx plane. A record
+    # with none of them ends exactly after the 9 planes.
     if rec.texts is not None or rec.props is not None:
         extras = json.dumps({"texts": rec.texts,
                              "props": rec.props}).encode()
         parts.append(struct.pack("<q", len(extras)))
         parts.append(extras)
-        parts.append(np.ascontiguousarray(rec.tidx, dtype="<i8").tobytes())
+        parts.append(plane_bytes(rec.tidx))
     return b"".join(parts)
 
 
-def decode_columnar(data: bytes):
+def decode_columnar(data: bytes, widths: bool = True):
+    """``widths=True`` decodes the v3 width-coded frame (tag b"D");
+    False decodes the legacy all-int64 frame (tag b"C", old logs)."""
     import numpy as np
     from .serving import ColumnarOps  # lazy: serving does not import us
     n, ts, dlen, tlen = _COL_HEADER.unpack_from(data)
@@ -118,19 +142,27 @@ def decode_columnar(data: bytes):
     off += dlen
     text = data[off:off + tlen].decode()
     off += tlen
+
+    def take_plane(off):
+        if widths:
+            w = data[off]
+            arr = np.frombuffer(data, dtype=f"<i{w}", count=n,
+                                offset=off + 1).astype(np.int64)
+            return arr, off + 1 + w * n
+        arr = np.frombuffer(data, dtype="<i8", count=n, offset=off).copy()
+        return arr, off + 8 * n
+
     planes = {}
     for f in _COL_FIELDS:
-        planes[f] = np.frombuffer(data, dtype="<i8", count=n,
-                                  offset=off).copy()
-        off += 8 * n
+        planes[f], off = take_plane(off)
     texts = props = tidx = None
-    if off < len(data):  # v2 extras present
+    if off < len(data):  # extras present
         (elen,) = struct.unpack_from("<q", data, off)
         off += 8
         extras = json.loads(data[off:off + elen])
         off += elen
         texts, props = extras["texts"], extras["props"]
-        tidx = np.frombuffer(data, dtype="<i8", count=n, offset=off).copy()
+        tidx, off = take_plane(off)
     return ColumnarOps(doc_ids=doc_ids, text=text, timestamp=ts,
                        texts=texts, props=props, tidx=tidx, **planes)
 
@@ -203,7 +235,7 @@ class NativePartitionedLog:
         if isinstance(record, SequencedDocumentMessage):
             tag, data = b"N", encode_message(record)
         elif _is_columnar(record):
-            tag, data = b"C", encode_columnar(record)
+            tag, data = b"D", encode_columnar(record)
         else:
             # STRICT json — a silently-lossy str() fallback here would
             # corrupt recovery (oplog._spill_json's docstring names the
@@ -250,8 +282,10 @@ class NativePartitionedLog:
             return decode_message(raw[1:])
         if raw[:1] == b"M":  # pre-timestamp record from an older log
             return decode_message(raw[1:], header=_HEADER_V1)
-        if raw[:1] == b"C":
+        if raw[:1] == b"D":
             return decode_columnar(raw[1:])
+        if raw[:1] == b"C":  # legacy all-int64 columnar frame
+            return decode_columnar(raw[1:], widths=False)
         return json.loads(raw[1:])
 
     def read(self, partition: int, from_offset: int = 0):

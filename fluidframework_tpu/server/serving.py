@@ -87,10 +87,18 @@ class ColumnarOps:
     props: Optional[List[dict]] = None     # single-key annotate table
     tidx: Optional[np.ndarray] = None      # (N,) table index per op
 
-    def expand(self):
-        """Per-op SequencedDocumentMessage stream (log-tail replay)."""
+    def expand(self, only_doc: Optional[str] = None):
+        """Per-op SequencedDocumentMessage stream (log-tail replay).
+        ``only_doc`` expands just that document's slice — the per-doc
+        rebuild path must not materialize the whole batch."""
+        idxs = range(len(self.seq))
+        if only_doc is not None:
+            if only_doc not in self.doc_ids:
+                return []
+            want = self.doc_ids.index(only_doc)
+            idxs = np.flatnonzero(np.asarray(self.doc) == want)
         out = []
-        for i in range(len(self.seq)):
+        for i in idxs:
             k = int(self.kind[i])
             if k == OpKind.STR_INSERT:
                 text = self.text if self.texts is None \
@@ -344,7 +352,11 @@ class ServingEngineBase:
                         continue
                     if msg.type == MessageType.OP:
                         self._enqueue(msg.doc_id, msg)
-                        self._min_seq[msg.doc_id] = msg.min_seq
+                        # max, not last-write: whole-batch columnar records
+                        # round-robin across partitions, so partition scan
+                        # order is not chronological
+                        self._min_seq[msg.doc_id] = max(
+                            self._min_seq.get(msg.doc_id, 0), msg.min_seq)
         self._queue.sort(key=lambda dm: dm[1].seq)
 
 
@@ -377,6 +389,23 @@ class StringServingEngine(ServingEngineBase):
         self.store = store if store is not None \
             else TensorStringStore(n_docs, capacity, n_props, mesh=mesh)
         self.mesh = getattr(self.store, "mesh", mesh)
+        # round-robin partition cursor for whole-batch columnar records
+        self._col_part = 0
+        # in-flight async overflow-flag copy (deferred harvest; see
+        # ingest_planes' compact-due branch)
+        self._ov_pending = None
+        # last summary + the dirty-detection baselines for incremental
+        # summaries (doc seqs / row map / interner table lengths)
+        self._summ_bookkeeping: Optional[dict] = None
+        # docs whose device planes were rewritten OUTSIDE the op stream
+        # (overflow re-upload): doc seq does not move, so seq-based dirty
+        # detection would miss them
+        self._dirty_outside_ops: set = set()
+        # bound the delta chain: past this depth summarize(incremental=
+        # True) produces a full summary instead (load()'s work and the
+        # retained base references stay bounded)
+        self.max_incremental_chain = 8
+        self._chain_depth = 0
         # mega tier: documents too long for one chip's slot budget are
         # served by the segment-axis-sharded store (declare with mark_mega
         # BEFORE the doc's first op; capacity here is per shard per doc)
@@ -628,6 +657,7 @@ class StringServingEngine(ServingEngineBase):
         handles = np.repeat(self._row_handle[rows], O)
         out_seq, out_min = raw.sequence_batch_rows(
             handles, flat(client), flat(client_seq), flat(ref_seq))
+        _t_seq = time.perf_counter()
         # poison-by-default from here to the end of the log append: ANY
         # failure in between (device apply, packing, a partition append)
         # leaves doc.seq — and possibly device state — ahead of the
@@ -669,41 +699,73 @@ class StringServingEngine(ServingEngineBase):
             np.asarray(client, np.int32),
             np.asarray(ref_seq, np.int32), text, min_seq=ms_arr,
             texts=texts, tidx=tidx, props=props)
+        _t_apply = time.perf_counter()
 
-        # durable log (host work, overlapped with the device apply): one
-        # ColumnarOps record per touched partition, ops grouped by ONE
-        # stable partition sort (not a mask scan per partition×field). The
+        # durable log (host work, overlapped with the device apply). The
         # logged ref_seq is the CLAMPED one (min(ref, seq-1), what the
         # sequencer recorded): replaying a raw inflated ref would push a
         # client's ref_seq past doc.seq after recovery and permanently nack
         # every later op (the clamp invariant in sequence_on).
         ts = self.deli.clock()
         rowidx = np.repeat(np.arange(R, dtype=np.int32), O)
-        parts = np.repeat(self._row_part[rows], O)
         ids = [self._row_doc_id[r] for r in rows]
-        ok_idx = np.flatnonzero(~nacked)
-        order = ok_idx[np.argsort(parts[ok_idx], kind="stable")]
-        p_sorted = parts[order]
-        bounds = np.searchsorted(
-            p_sorted, np.arange(self.log.n_partitions + 1))
         flat_client = flat(client)
         ref_clamped = np.minimum(flat(ref_seq).astype(np.int64),
                                  np.maximum(out_seq - 1, 0))
-        fields = (flat_client, flat(client_seq), ref_clamped,
-                  out_seq, out_min, kind.reshape(-1), flat(a0), flat(a1))
-        gathered = tuple(f[order] for f in fields)
-        row_sorted = rowidx[order]
-        tidx_flat = None if tidx is None else flat(tidx)[order]
-        for p in range(self.log.n_partitions):
-            lo, hi = bounds[p], bounds[p + 1]
-            if lo == hi:
-                continue
-            sl = slice(lo, hi)
+        if not nacked.any():
+            # hot path: the whole batch is ONE ColumnarOps record (the
+            # Kafka-batch analog) — no partition sort, no per-field
+            # gathers. Batch records round-robin across partitions for
+            # balance; a doc's columnar history is reassembled seq-ordered
+            # at read (_doc_log_messages scans all partitions — recovery
+            # only). Copies detach the log from caller-owned planes.
+            p = self._col_part
+            self._col_part = (p + 1) % self.log.n_partitions
             self.log.append(int(p), ColumnarOps(
-                ids, row_sorted[sl], *(g[sl] for g in gathered),
-                text=text, timestamp=ts, texts=texts, props=props,
-                tidx=None if tidx_flat is None else tidx_flat[sl]))
+                ids, rowidx, flat_client.copy(), flat(client_seq).copy(),
+                ref_clamped, out_seq, out_min, kind.reshape(-1).copy(),
+                flat(a0).copy(), flat(a1).copy(), text=text, timestamp=ts,
+                texts=texts, props=props,
+                tidx=None if tidx is None else flat(tidx).copy()))
+        else:
+            # nacked slots present (rare): group the survivors by doc
+            # partition with ONE stable sort, one record per partition
+            parts = np.repeat(self._row_part[rows], O)
+            ok_idx = np.flatnonzero(~nacked)
+            order = ok_idx[np.argsort(parts[ok_idx], kind="stable")]
+            p_sorted = parts[order]
+            bounds = np.searchsorted(
+                p_sorted, np.arange(self.log.n_partitions + 1))
+            fields = (flat_client, flat(client_seq), ref_clamped,
+                      out_seq, out_min, kind.reshape(-1), flat(a0),
+                      flat(a1))
+            gathered = tuple(f[order] for f in fields)
+            row_sorted = rowidx[order]
+            tidx_flat = None if tidx is None else flat(tidx)[order]
+            for p in range(self.log.n_partitions):
+                lo, hi = bounds[p], bounds[p + 1]
+                if lo == hi:
+                    continue
+                sl = slice(lo, hi)
+                self.log.append(int(p), ColumnarOps(
+                    ids, row_sorted[sl], *(g[sl] for g in gathered),
+                    text=text, timestamp=ts, texts=texts, props=props,
+                    tidx=None if tidx_flat is None else tidx_flat[sl]))
         self._poisoned = None  # sequence → merge → log completed
+        # per-stage host wall (the throughput breakdown): C++ sequencing,
+        # plane prep + wire packing, async device dispatch, log append —
+        # device time itself is covered by the caller's end sync
+        _t_log = time.perf_counter()
+        st = getattr(self.store, "last_apply_stats", None) or {}
+        self.metrics.observe("ingest_seq_ms", (_t_seq - t0) * 1000)
+        self.metrics.observe("ingest_pack_ms", st.get("pack_ms", 0.0))
+        self.metrics.observe("ingest_dispatch_ms",
+                             st.get("dispatch_ms", 0.0))
+        self.metrics.observe(
+            "ingest_prep_ms",
+            (_t_apply - _t_seq) * 1000 - st.get("pack_ms", 0.0)
+            - st.get("dispatch_ms", 0.0))
+        self.metrics.observe("ingest_log_ms", (_t_log - _t_apply) * 1000)
 
         if self._attributors is not None:
             ok = ~nacked
@@ -724,8 +786,24 @@ class StringServingEngine(ServingEngineBase):
                 self.mega_store.compact(mms)
             for doc_id, store in self._graduated.items():
                 store.compact(self._min_seq.get(doc_id, 0))
-            if self.auto_recover:  # same contract as compact(): recovery
-                self.recover_overflowed()  # runs on the compaction cadence
+            if self.auto_recover:
+                # DEFERRED overflow harvest: a synchronous flag read here
+                # would stall the dispatch pipeline one tunnel RTT per
+                # compaction. Instead start an async device→host copy of
+                # the flags now and inspect the PREVIOUS compaction's copy
+                # (already landed) — detection is one compaction late,
+                # which only delays recovery (the log has every acked op).
+                prev = self._ov_pending
+                # jnp.copy: the live overflow buffer is donated away by
+                # the next merge; the stash must own its storage
+                import jax.numpy as jnp
+                self._ov_pending = jnp.copy(self.store.state.overflow)
+                try:
+                    self._ov_pending.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass
+                if prev is not None and np.asarray(prev).any():
+                    self.recover_overflowed()
         else:
             self._flushes_since_compact += 1
         return {"seq": seq_rs, "nacked": int(nacked.sum())}
@@ -847,17 +925,19 @@ class StringServingEngine(ServingEngineBase):
 
     def _doc_log_messages(self, doc_id: str):
         """Every sequenced OP message for one doc, seq-ascending, from the
-        durable log (ColumnarOps records expand; a doc lives entirely in
-        one partition, so the log holds its full history in order)."""
-        p = partition_of(doc_id, self.log.n_partitions)
+        durable log. Per-op records live in the doc's own partition;
+        whole-batch ColumnarOps records round-robin across partitions, so
+        ALL partitions are scanned for them (recovery-only path) and the
+        final seq sort restores the doc's total order."""
+        p_own = partition_of(doc_id, self.log.n_partitions)
         msgs = []
-        for rec in self.log.read(p):
-            if isinstance(rec, ColumnarOps):
-                if doc_id in rec.doc_ids:
-                    msgs.extend(m for m in rec.expand()
-                                if m.doc_id == doc_id)
-            elif rec.doc_id == doc_id and rec.type == MessageType.OP:
-                msgs.append(rec)
+        for p in range(self.log.n_partitions):
+            for rec in self.log.read(p):
+                if isinstance(rec, ColumnarOps):
+                    msgs.extend(rec.expand(only_doc=doc_id))
+                elif p == p_own and rec.doc_id == doc_id \
+                        and rec.type == MessageType.OP:
+                    msgs.append(rec)
         msgs.sort(key=lambda m: m.seq)
         return msgs
 
@@ -892,6 +972,9 @@ class StringServingEngine(ServingEngineBase):
         if int(np.asarray(tmp.state.count[0])) <= self.store.capacity:
             self.store.adopt_doc(row, tmp)
             self._readd_intervals(self.store, row, ivs)
+            # planes changed without the doc sequencing anything: the next
+            # incremental summary must ship this row
+            self._dirty_outside_ops.add(doc_id)
             return "reuploaded"
         self.store._intervals[row] = {}
         self.store.clear_doc(row)
@@ -942,18 +1025,67 @@ class StringServingEngine(ServingEngineBase):
 
     # ----------------------------------------------------- summary / recovery
 
-    def summarize(self) -> dict:
+    def summarize(self, incremental: bool = False) -> dict:
         """Flush + compact, then capture the recovery summary: store
-        snapshot, sequencer checkpoint, per-partition log offsets, doc map."""
+        snapshot, sequencer checkpoint, per-partition log offsets, doc map.
+
+        ``incremental=True`` (after at least one full summary this
+        session) captures a DELTA instead: only rows whose document
+        sequenced an op since the last summary — detected host-side from
+        the sequencer, no device read — plus rows whose doc→row mapping
+        changed (graduations, row reuse), plus append-only interner
+        deltas. Clean rows are carried by REFERENCE to the previous
+        summary (``base``) — the handle-reuse summary of SURVEY.md §2.16.
+        A mostly-idle store summarizes in O(changed) bytes."""
         self.flush()
         self.compact()
-        summary = self._base_summary()
-        summary["store"] = self.store.snapshot()
-        summary["mega_store"] = self.mega_store.snapshot() \
-            if self.mega_store is not None else None
-        summary["mega_rows"] = dict(self._mega_rows)
-        summary["graduated"] = {d: s.snapshot()
-                                for d, s in self._graduated.items()}
+        prev = self._summ_bookkeeping
+        if incremental and prev is not None \
+                and self._chain_depth < self.max_incremental_chain:
+            cur_seqs = {d: self.deli.doc_seq(d) for d in self._doc_rows}
+            dirty_rows = {row for d, row in self._doc_rows.items()
+                          if cur_seqs[d] != prev["doc_seqs"].get(d)}
+            # rows whose mapping changed since the base: their planes may
+            # have been cleared or adopted outside the op stream
+            dirty_rows |= {row for d, row in prev["row_of"].items()
+                          if self._doc_rows.get(d) != row}
+            # rows rewritten in place (overflow re-upload): no seq delta
+            dirty_rows |= {self._doc_rows[d]
+                           for d in self._dirty_outside_ops
+                           if d in self._doc_rows}
+            summary = self._base_summary()
+            summary["kind"] = "delta"
+            summary["base"] = prev["summary"]
+            summary["store_delta"] = self.store.snapshot_rows(
+                sorted(dirty_rows), prev["payloads_len"],
+                prev["prop_values_len"])
+            # the small/rare tiers snapshot in full (mega stores shard
+            # few docs; graduated stores are single-doc)
+            summary["mega_store"] = self.mega_store.snapshot() \
+                if self.mega_store is not None else None
+            summary["mega_rows"] = dict(self._mega_rows)
+            summary["graduated"] = {d: s.snapshot()
+                                    for d, s in self._graduated.items()}
+            self._chain_depth += 1
+        else:
+            summary = self._base_summary()
+            summary["kind"] = "full"
+            self._chain_depth = 0
+            summary["store"] = self.store.snapshot()
+            summary["mega_store"] = self.mega_store.snapshot() \
+                if self.mega_store is not None else None
+            summary["mega_rows"] = dict(self._mega_rows)
+            summary["graduated"] = {d: s.snapshot()
+                                    for d, s in self._graduated.items()}
+            cur_seqs = {d: self.deli.doc_seq(d) for d in self._doc_rows}
+        self._dirty_outside_ops.clear()
+        self._summ_bookkeeping = {
+            "summary": summary,
+            "doc_seqs": cur_seqs,
+            "row_of": dict(self._doc_rows),
+            "payloads_len": len(self.store._payloads),
+            "prop_values_len": len(self.store._prop_values),
+        }
         return summary
 
     @classmethod
@@ -962,8 +1094,17 @@ class StringServingEngine(ServingEngineBase):
         """Resume from a summary + the durable log: restore the device
         state, restore the sequencer, then replay the log tail through the
         same apply kernels — the single recovery primitive. ``mesh``
-        re-shards the restored planes (recovery onto a fresh mesh)."""
-        store = TensorStringStore.restore(summary["store"], mesh=mesh)
+        re-shards the restored planes (recovery onto a fresh mesh).
+        Incremental summaries resolve their base chain: the newest full
+        summary restores, then each delta's dirty rows overwrite."""
+        chain = []
+        full = summary
+        while full.get("kind") == "delta":
+            chain.append(full)
+            full = full["base"]
+        store = TensorStringStore.restore(full["store"], mesh=mesh)
+        for delta in reversed(chain):
+            store.apply_row_snapshot(delta["store_delta"])
         mega = None
         if summary.get("mega_store") is not None:
             from ..ops.megadoc_store import MegaDocStringStore

@@ -473,9 +473,61 @@ class _FailingLog(PartitionedLog):
 
 
 def test_append_failure_poisons_engine_and_blocks_summary():
-    """If the durable-log append fails mid-batch AFTER the device merge was
+    """If the durable-log append fails AFTER the device merge was
     dispatched, the engine must refuse further ingest and summaries: a
-    summary taken now would durably persist ops the log never recorded."""
+    summary taken now would durably persist ops the log never recorded.
+    A clean batch is ONE whole-batch record, so the failure is
+    all-or-nothing: recovery must see exactly the pre-failure state."""
+    R, O = 4, 8
+    log = _FailingLog(4)
+    eng = StringServingEngine(n_docs=R, capacity=256, batch_window=10 ** 9,
+                              sequencer="native", log=log, n_partitions=4)
+    docs = [f"doc-{i}" for i in range(R)]
+    for d in docs:
+        eng.connect(d, 1)
+    rows = np.array([eng.doc_row(d) for d in docs], np.int32)
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    batches = _batches(R, O, 2)
+    kind, a0, a1, cseq = batches[0]
+    eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    good_summary = eng.summarize()
+    good_text = {d: eng.read_text(d) for d in docs}
+
+    log.arm(0)  # the batch's (single) whole-batch append explodes
+    kind, a0, a1, cseq = batches[1]
+    with pytest.raises(IOError):
+        eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+
+    # poisoned: no more ingest (either path), no summary — summarizing now
+    # would durably persist the device-applied-but-unlogged ops
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.submit(docs[0], 1, 99, 0,
+                   {"mt": "insert", "kind": 0, "pos": 0, "text": "x"})
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.summarize()
+
+    # recovery from the pre-failure summary + log: the failed batch's ops
+    # are GONE — the device had applied them, the rebuilt engine never
+    # sees them, and resubmission continues the sequence space
+    log.fail = False
+    revived = StringServingEngine.load(good_summary, log)
+    assert {d: revived.read_text(d) for d in docs} == good_text
+    msg, nack = revived.submit(
+        docs[0], 1, O + 1, 0,
+        {"mt": "insert", "kind": 0, "pos": 0, "text": "Z"})
+    assert nack is None
+    assert revived.read_text(docs[0]) == "Z" + good_text[docs[0]]
+
+
+def test_partial_append_failure_with_nacks_poisons():
+    """The nacked-batch path appends one record per partition; a failure
+    partway through leaves a PARTIAL batch in the log. The engine must
+    poison, and recovery must replay exactly the logged prefix: unlogged
+    partitions' docs read the pre-failure text, logged partitions' docs
+    match a reference engine fed the same accepted ops."""
     R, O = 4, 8
     log = _FailingLog(4)
     eng = StringServingEngine(n_docs=R, capacity=256, batch_window=10 ** 9,
@@ -493,37 +545,27 @@ def test_append_failure_poisons_engine_and_blocks_summary():
     good_text = {d: eng.read_text(d) for d in docs}
 
     sizes_before = [log.size(p) for p in range(4)]
-    log.arm(1)  # the batch's second partition append explodes
     kind, a0, a1, cseq = batches[1]
+    cseq = cseq.copy()
+    cseq[2, 5] = 10 ** 6   # nack cascade → per-partition append path
+    log.arm(1)             # second partition append explodes
     with pytest.raises(IOError):
         eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
-
-    # poisoned: no more ingest (either path), no summary — summarizing now
-    # would durably persist the device-applied-but-unlogged ops
-    with pytest.raises(RuntimeError, match="poisoned"):
-        eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1, TEXT)
-    with pytest.raises(RuntimeError, match="poisoned"):
-        eng.submit(docs[0], 1, 99, 0,
-                   {"mt": "insert", "kind": 0, "pos": 0, "text": "x"})
     with pytest.raises(RuntimeError, match="poisoned"):
         eng.summarize()
 
-    # recovery from the pre-failure summary + log: ops whose partition
-    # append SUCCEEDED are durably sequenced and legitimately replay
-    # (unacked-but-logged); ops whose append failed must be GONE — the
-    # device had applied them, but the rebuilt engine never sees them
     log.fail = False
     logged_parts = {p for p in range(4) if log.size(p) > sizes_before[p]}
     assert logged_parts and logged_parts != set(range(4))  # genuine partial
     revived = StringServingEngine.load(good_summary, log)
     from fluidframework_tpu.server.oplog import partition_of
-    unlogged = [d for d in docs if partition_of(d, 4) not in logged_parts]
     logged = [d for d in docs if partition_of(d, 4) in logged_parts]
+    unlogged = [d for d in docs if partition_of(d, 4) not in logged_parts]
     assert unlogged
     for d in unlogged:
         assert revived.read_text(d) == good_text[d], d
-    # parity for the partially-logged docs: a reference engine fed batch 1
-    # plus batch 2 only for those docs must agree
+    # parity for the logged docs: a reference engine fed batch 1 plus the
+    # ACCEPTED batch-2 ops of those docs must agree
     ref_eng = StringServingEngine(n_docs=R, capacity=256,
                                   batch_window=10 ** 9)
     for d in docs:
@@ -542,18 +584,11 @@ def test_append_failure_poisons_engine_and_blocks_summary():
                     c = {"mt": "remove", "start": int(b_a0[di, o]),
                          "end": int(b_a1[di, o])}
                 _, nack = ref_eng.submit(d, 1, int(b_cseq[di, o]), 0, c)
-                assert nack is None
+                if only is None:
+                    assert nack is None  # batch 1 is clean; batch 2's
+                    # gap doc may legitimately nack its cascade tail
     for d in docs:
         assert revived.read_text(d) == ref_eng.read_text(d), d
-
-    # the revived engine serves and sequences past the replayed tail
-    nxt = 2 * O + 1 if docs[0] in logged else O + 1
-    before = revived.read_text(docs[0])
-    msg, nack = revived.submit(
-        docs[0], 1, nxt, 0,
-        {"mt": "insert", "kind": 0, "pos": 0, "text": "Z"})
-    assert nack is None
-    assert revived.read_text(docs[0]) == "Z" + before
 
 
 def test_props_without_tidx_rejected_before_sequencing():

@@ -1,0 +1,204 @@
+"""Incremental summaries (SURVEY.md §2.16: handle reuse): a second
+summary of a mostly-idle store must upload O(changed) bytes, and the
+delta chain must restore bit-identically through load()."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.server import native_deli
+from fluidframework_tpu.server.serving import StringServingEngine
+
+pytestmark = pytest.mark.skipif(not native_deli.available(),
+                                reason="native sequencer unavailable")
+
+
+def _delta_bytes(summary: dict) -> int:
+    """Serialized size of a summary EXCLUDING its by-reference base —
+    what an incremental upload actually ships."""
+    slim = {k: v for k, v in summary.items() if k != "base"}
+    return len(pickle.dumps(slim))
+
+
+def _mk(n_docs=1024, O=16):
+    eng = StringServingEngine(n_docs=n_docs, capacity=128,
+                              batch_window=10 ** 9, sequencer="native")
+    docs = [f"doc-{i}" for i in range(n_docs)]
+    for d in docs:
+        eng.connect(d, 1)
+        eng.doc_row(d)
+    rows = np.array([eng.doc_row(d) for d in docs], np.int32)
+    return eng, docs, rows, O, np.ones(n_docs, np.int64)
+
+
+def _ingest(eng, rows, O, next_cseq, subset=None):
+    """Insert-only batch for all rows (or a subset); ``next_cseq`` is the
+    per-doc clientSeq cursor array, advanced in place."""
+    idx = np.arange(len(rows)) if subset is None else \
+        np.arange(len(rows))[subset]
+    r = rows[idx]
+    R = len(r)
+    kind = np.zeros((R, O), np.int32)
+    z = np.zeros((R, O), np.int32)
+    cseq = (next_cseq[idx][:, None] +
+            np.arange(O, dtype=np.int64)[None, :]).astype(np.int32)
+    res = eng.ingest_planes(r, np.ones((R, O), np.int32), cseq, z,
+                            kind, z, z, "abcd")
+    assert res["nacked"] == 0
+    next_cseq[idx] += O
+
+
+def test_second_summary_of_idle_store_is_small():
+    eng, docs, rows, O, nc = _mk()
+    _ingest(eng, rows, O, nc)
+    full = eng.summarize()
+    full_bytes = _delta_bytes(full)
+    # touch 5 of 1024 docs, then summarize incrementally
+    _ingest(eng, rows, O, nc, subset=slice(0, 5))
+    delta = eng.summarize(incremental=True)
+    assert delta["kind"] == "delta"
+    assert len(delta["store_delta"]["rows"]) == 5
+    d_bytes = _delta_bytes(delta)
+    # O(changed): the 5-row delta must be far below the 1024-row full
+    assert d_bytes < full_bytes / 10, (d_bytes, full_bytes)
+
+    # an untouched store's next delta carries ZERO rows: the store
+    # payload vanishes entirely; what remains is the O(n_docs) protocol
+    # metadata (sequencer checkpoint + doc-row map), which every summary
+    # must carry fresh
+    idle = eng.summarize(incremental=True)
+    assert len(idle["store_delta"]["rows"]) == 0
+    assert len(pickle.dumps(idle["store_delta"])) < 5000
+    assert _delta_bytes(idle) < full_bytes / 10
+
+
+def test_delta_chain_restores_exactly():
+    eng, docs, rows, O, nc = _mk(n_docs=64)
+    _ingest(eng, rows, O, nc)
+    eng.summarize()
+    _ingest(eng, rows, O, nc, subset=slice(0, 7))
+    s1 = eng.summarize(incremental=True)
+    _ingest(eng, rows, O, nc, subset=slice(5, 12))
+    s2 = eng.summarize(incremental=True)  # chain: s2 -> s1 -> full
+    # ops AFTER the last summary ride the log tail as usual
+    _ingest(eng, rows, O, nc, subset=slice(60, 64))
+    want = {d: eng.read_text(d) for d in docs}
+
+    revived = StringServingEngine.load(s2, eng.log)
+    # read_text is the semantic parity check; digests are identity-
+    # sensitive (tail replay re-interns payloads at different handles)
+    assert {d: revived.read_text(d) for d in docs} == want
+    # sequencing resumes past the tail
+    msg, nack = revived.submit(
+        docs[0], 1, int(nc[0]), 0,
+        {"mt": "insert", "kind": 0, "pos": 0, "text": "Z"})
+    assert nack is None
+
+
+def test_incremental_covers_rich_payload_tables():
+    """Interner deltas: payload/props tables grow append-only; a delta
+    must carry only the NEW entries and restore them."""
+    from fluidframework_tpu.ops.schema import OpKind
+    eng, docs, rows, O, nc = _mk(n_docs=32, O=8)
+    texts0 = [f"t{k}" for k in range(O)]
+    props0 = [{"b": 1}]
+    R = len(rows)
+    kind = np.zeros((R, O), np.int32)
+    tidx = np.broadcast_to(np.arange(O, dtype=np.int32), (R, O)).copy()
+    z = np.zeros((R, O), np.int32)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32), (R, O))
+    eng.ingest_planes(rows, np.ones((R, O), np.int32), cseq, z, kind,
+                      z, z, texts=texts0, tidx=tidx, props=props0)
+    full = eng.summarize()
+    n_payloads = len(eng.store._payloads)
+
+    texts1 = [f"u{k}" for k in range(O)]
+    kind2 = kind.copy()
+    kind2[:, -1] = int(OpKind.STR_ANNOTATE)
+    a1 = z.copy()
+    a1[:, -1] = 2
+    props1 = [{"c": "red"}]
+    tidx2 = tidx.copy()
+    tidx2[:, -1] = 0
+    cseq2 = cseq + O
+    eng.ingest_planes(rows[:4], np.ones((4, O), np.int32), cseq2[:4],
+                      z[:4], kind2[:4], z[:4], a1[:4],
+                      texts=texts1, tidx=tidx2[:4], props=props1)
+    delta = eng.summarize(incremental=True)
+    assert len(delta["store_delta"]["payloads_delta"]) == \
+        len(eng.store._payloads) - n_payloads
+    want = {d: eng.read_text(d) for d in docs}
+    revived = StringServingEngine.load(delta, eng.log)
+    assert {d: revived.read_text(d) for d in docs} == want
+    assert revived.get_properties(docs[0], 0) == \
+        eng.get_properties(docs[0], 0)
+
+
+def test_graduation_dirties_the_freed_row():
+    """A doc that graduates off the flat tier frees its row; the next
+    incremental summary must ship that row's (cleared or re-adopted)
+    planes — stale clean-row reuse would resurrect the old doc."""
+    eng, docs, rows, O, nc = _mk(n_docs=16)
+    _ingest(eng, rows, O, nc)
+    eng.summarize()
+    # overflow doc 0 (capacity 128): per-op inserts of distinct chars
+    eng.auto_recover = False
+    for i in range(140):
+        _, nack = eng.submit(docs[0], 1, O + 1 + i,
+                             0, {"mt": "insert", "kind": 0, "pos": 0,
+                                 "text": "Q"})
+        assert nack is None
+    eng.flush()
+    report = eng.recover_overflowed()
+    assert report.get(docs[0]) == "graduated", report
+    delta = eng.summarize(incremental=True)
+    freed_row = 0  # doc-0 held row 0
+    assert freed_row in set(int(r) for r in delta["store_delta"]["rows"])
+    want = {d: eng.read_text(d) for d in docs}
+    revived = StringServingEngine.load(delta, eng.log)
+    assert {d: revived.read_text(d) for d in docs} == want
+
+
+def test_reupload_dirties_row_without_seq_delta():
+    """Overflow re-upload (adopt_doc) rewrites a row's planes WITHOUT the
+    doc sequencing anything new; the next incremental summary must ship
+    that row anyway (review r4 finding)."""
+    eng, docs, rows, O, nc = _mk(n_docs=16)
+    _ingest(eng, rows, O, nc)
+    eng.auto_recover = False
+    # overflow doc 0 with tombstoned churn so the rebuild FITS (reupload)
+    for i in range(140):
+        _, nack = eng.submit(docs[0], 1, int(nc[0]) + i, 0,
+                             {"mt": "insert", "kind": 0, "pos": 0,
+                              "text": "Q"})
+        assert nack is None
+    nc[0] += 140
+    for i in range(130):
+        _, nack = eng.submit(docs[0], 1, int(nc[0]) + i, 140 + O,
+                             {"mt": "remove", "start": 0, "end": 1})
+        assert nack is None
+    nc[0] += 130
+    eng.flush()
+    eng.heartbeat(docs[0], 1, eng.deli.doc_seq(docs[0]))
+    eng.summarize()  # full summary AFTER the ops, BEFORE the re-upload
+    report = eng.recover_overflowed()
+    assert report.get(docs[0]) == "reuploaded", report
+    delta = eng.summarize(incremental=True)
+    assert 0 in set(int(r) for r in delta["store_delta"]["rows"])
+    want = {d: eng.read_text(d) for d in docs}
+    revived = StringServingEngine.load(delta, eng.log)
+    assert {d: revived.read_text(d) for d in docs} == want
+
+
+def test_chain_depth_cap_falls_back_to_full():
+    eng, docs, rows, O, nc = _mk(n_docs=8, O=4)
+    _ingest(eng, rows, O, nc)
+    eng.max_incremental_chain = 2
+    eng.summarize()
+    for i in range(2):
+        _ingest(eng, rows, O, nc, subset=slice(0, 1))
+        assert eng.summarize(incremental=True)["kind"] == "delta"
+    _ingest(eng, rows, O, nc, subset=slice(0, 1))
+    assert eng.summarize(incremental=True)["kind"] == "full"  # cap hit
+    assert eng.summarize(incremental=True)["kind"] == "delta"  # reset
