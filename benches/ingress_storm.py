@@ -1,0 +1,64 @@
+"""Ingress-tier throughput: raw ops through the REAL socket front door
+(Alfred analog) — framed-JSON TCP → LocalService pipeline (Kafka-role
+log → Deli → Broadcaster) → sequenced broadcast back to the client.
+Measures the wire + ordering-service tier itself (the device merge is
+not in this path; see bench.py / BENCHES.md for the engine numbers).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import socket
+import threading
+import time
+
+
+def main(n_ops: int = 20000, pipeline: int = 256):
+    from fluidframework_tpu.server import wire
+    from fluidframework_tpu.server.ingress import AlfredServer
+
+    srv = AlfredServer(port=0).start_in_thread()
+    sock = socket.create_connection(("127.0.0.1", srv.port))
+    wire.send_frame(sock, {"t": "connect", "doc": "storm"})
+    assert wire.recv_frame(sock)["t"] == "connected"
+
+    got = [0]
+    done = threading.Event()
+
+    def reader():
+        while got[0] < n_ops:
+            if wire.recv_frame(sock).get("t") == "op":
+                got[0] += 1
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t0 = time.perf_counter()
+    t.start()
+    for i in range(n_ops):
+        wire.send_frame(sock, {"t": "op", "client_seq": i + 1,
+                               "contents": {"mt": "insert", "kind": 0,
+                                            "pos": 0, "text": "ab"},
+                               "ref_seq": 0})
+        while got[0] < i - pipeline:   # bounded in-flight window
+            time.sleep(0.0005)
+    assert done.wait(timeout=120), f"only {got[0]}/{n_ops} acked"
+    total = time.perf_counter() - t0
+    sock.close()
+    srv.stop()
+
+    print(json.dumps({
+        "metric": "ingress_ops_per_sec",
+        "value": round(n_ops / total, 1),
+        "unit": "ops/s",
+        "vs_baseline": None,
+        "total_ops": n_ops,
+        "pipeline_window": pipeline,
+        "transport": "tcp-localhost framed-JSON",
+    }))
+
+
+if __name__ == "__main__":
+    main()

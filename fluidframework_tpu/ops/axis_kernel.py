@@ -1,0 +1,212 @@
+"""Device permutation axes for SharedMatrix serving: merge + resolve.
+
+Reference counterpart: ``@fluidframework/matrix`` PermutationVector — a
+MergeTree whose "text" is the row/col key space (SURVEY.md §2.4). The
+serving engine previously walked host MergeTree observers per op; here
+the axis state IS the batched merge-tree kernel state (one row per
+(doc, axis)), and position→key resolution happens INSIDE the same scan
+that applies the axis mutations: a ``RESOLVE`` op computes, at its own
+(ref_seq, client) perspective, the run handle and within-run offset of
+the slot containing a position — without mutating state — and the scan
+emits those as per-op outputs. One device dispatch applies a whole
+window of axis inserts/removes AND resolves every setCell in it.
+
+Key identity: an inserted run interns (mixed opKey, key_offset) to a
+run handle (``handle_op``); ``handle_off`` accumulates across splits,
+so a resolved (run, handle_off + within) maps host-side to exactly the
+oracle's ``(seg.handle[0], seg.handle[1] + off)`` key tuple
+(``models/shared_matrix.py`` ``_Axis.resolve``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.constants import NOT_REMOVED
+from .merge_tree_kernel import (
+    MAX_CLIENTS, StringState, _insert_one, _iota, _prefix, _range_one,
+    _visible,
+)
+from .schema import OpKind
+
+_PLANES = ("seq", "client", "removed_seq", "removers", "length",
+           "handle_op", "handle_off")
+
+
+def _axis_state_dict(state: StringState):
+    return {k: getattr(state, k) for k in _PLANES} | {
+        "count": state.count, "overflow": state.overflow}
+
+
+def _resolve_one(s, pos, client_idx, ref_seq):
+    """(run handle, run offset) of the slot containing perspective
+    position ``pos`` — (-1, -1) when out of range. One-hot sums instead
+    of gathers (same rationale as the merge kernel)."""
+    vis = _visible(s, ref_seq, client_idx)
+    pre, end = _prefix(s, vis)
+    inside = vis & (pre <= pos) & (pos < end)
+    has = jnp.any(inside)
+    hop = jnp.sum(jnp.where(inside, s["handle_op"], 0))
+    base = jnp.sum(jnp.where(inside, s["handle_off"], 0))
+    preo = jnp.sum(jnp.where(inside, pre, 0))
+    return (jnp.where(has, hop, -1),
+            jnp.where(has, base + pos - preo, -1))
+
+
+def apply_axis_batch(state: StringState, kind, a0, a1, a2, seq, client,
+                     ref_seq):
+    """Apply a dense (D, O) batch of axis ops; returns (state, res_run,
+    res_off) where the latter two are (D, O) RESOLVE outputs (-1 at
+    non-resolve slots and out-of-range resolves).
+
+    STR_INSERT: a0=pos, a1=count, a2=run handle. STR_REMOVE: a0=start,
+    a1=end. AXIS_RESOLVE: a0=pos (emits output, mutates nothing). An
+    insert whose position exceeds its perspective's visible length is
+    DROPPED (the oracle raises and the engine drops — appending would
+    diverge)."""
+
+    def step(carry, op):
+        k, p0, p1, p2, sq, cl, rs = op
+        ins = jax.vmap(functools.partial(_insert_one, with_props=False)
+                       )(carry, p0, p1, p2, sq, cl, rs)
+        rng = jax.vmap(functools.partial(_range_one, with_props=False)
+                       )(carry, k, p0, p1, p2, sq, cl, rs)
+        res_h, res_o = jax.vmap(_resolve_one)(carry, p0, cl, rs)
+
+        def vis_len(s, cl_, rs_):
+            vis = _visible(s, rs_, cl_)
+            return jnp.sum(jnp.where(vis, s["length"], 0))
+
+        total = jax.vmap(vis_len)(carry, cl, rs)
+        ins_ok = p0 <= total
+
+        def pick(key):
+            tail = (1,) * (carry[key].ndim - 1)
+            is_ins = ((k == OpKind.STR_INSERT) & ins_ok).reshape(
+                (-1,) + tail)
+            is_rng = (k == OpKind.STR_REMOVE).reshape((-1,) + tail)
+            return jnp.where(is_ins, ins[key],
+                             jnp.where(is_rng, rng[key], carry[key]))
+
+        out = {key: pick(key) for key in carry}
+        is_res = k == OpKind.AXIS_RESOLVE
+        y = (jnp.where(is_res, res_h, -1), jnp.where(is_res, res_o, -1))
+        return out, y
+
+    sd = _axis_state_dict(state)
+    pv = state.prop_val  # threads through untouched (axes carry no props)
+    ops = (kind.T, a0.T, a1.T, a2.T, seq.T, client.T, ref_seq.T)
+    out, (ys_h, ys_o) = jax.lax.scan(step, sd, ops)
+    out["prop_val"] = pv
+    return StringState(**out), ys_h.T, ys_o.T
+
+
+apply_axis_batch_jit = jax.jit(apply_axis_batch, donate_argnums=0)
+
+
+@jax.jit
+def axis_visible_lengths(state: StringState):
+    """(D,) latest-view visible length per axis row (dims read)."""
+    S = state.seq.shape[1]
+    active = jnp.arange(S)[None, :] < state.count[:, None]
+    live = active & (state.removed_seq == NOT_REMOVED)
+    return jnp.sum(jnp.where(live, state.length, 0), axis=1)
+
+
+class TensorAxisStore:
+    """Host facade: 2 permutation axes per matrix doc (rows at
+    ``2·doc``, cols at ``2·doc + 1``), resident as one StringState.
+    Run identities intern (mixed opKey, key_offset) → int32 handles;
+    per-axis-row client interning feeds the remover bitmask."""
+
+    def __init__(self, n_docs: int, capacity: int = 256):
+        self.n_docs = n_docs
+        self.capacity = capacity
+        self.state = StringState.create(2 * n_docs, capacity, n_props=1)
+        self._runs: List[Tuple[int, int]] = [(0, 0)]  # run 0 reserved
+        self._run_ids: Dict[Tuple[int, int], int] = {}
+        self._client_idx: List[Dict[int, int]] = [
+            dict() for _ in range(2 * n_docs)]
+
+    def run_handle(self, mixed: int, key_offset: int) -> int:
+        k = (int(mixed), int(key_offset))
+        if k not in self._run_ids:
+            self._run_ids[k] = len(self._runs)
+            self._runs.append(k)
+        return self._run_ids[k]
+
+    def run_key(self, handle: int, off: int) -> Tuple[int, int]:
+        mixed, base = self._runs[handle]
+        return (mixed, base + off)
+
+    def client(self, axis_row: int, client_id: int) -> int:
+        m = self._client_idx[axis_row]
+        if client_id not in m:
+            if len(m) >= MAX_CLIENTS:
+                raise KeyError(f"axis {axis_row}: client capacity")
+            m[client_id] = len(m)
+        return m[client_id]
+
+    def apply(self, planes: dict) -> Tuple[np.ndarray, np.ndarray]:
+        """One device dispatch; returns host (D2, O) resolve outputs
+        (the flush's single device→host read)."""
+        self.state, rh, ro = apply_axis_batch_jit(
+            self.state,
+            *(jnp.asarray(planes[k]) for k in
+              ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")))
+        return np.asarray(rh), np.asarray(ro)
+
+    def visible_lengths(self) -> np.ndarray:
+        return np.asarray(axis_visible_lengths(self.state))
+
+    def compact(self, min_seq: np.ndarray) -> None:
+        from .merge_tree_kernel import compact_string_state_jit
+        self.state = compact_string_state_jit(
+            self.state, jnp.asarray(min_seq), with_props=False)
+
+    def overflowed(self) -> np.ndarray:
+        return np.asarray(self.state.overflow)
+
+    # ----------------------------------------------------- snapshot/resume
+
+    def snapshot(self) -> dict:
+        st = self.state
+        n = max(int(np.asarray(st.count).max()), 1)
+        return {
+            "planes": {k: np.asarray(getattr(st, k))[:, :n].copy()
+                       for k in _PLANES},
+            "count": np.asarray(st.count).copy(),
+            "overflow": np.asarray(st.overflow).copy(),
+            "capacity": self.capacity,
+            "runs": [list(r) for r in self._runs],
+            "client_idx": [dict(m) for m in self._client_idx],
+        }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "TensorAxisStore":
+        store = cls.__new__(cls)
+        store.n_docs = snap["count"].shape[0] // 2
+        store.capacity = snap["capacity"]
+        cap = snap["capacity"]
+        full = {}
+        for k in _PLANES:
+            small = np.asarray(snap["planes"][k])
+            fill = NOT_REMOVED if k == "removed_seq" else 0
+            plane = np.full((snap["count"].shape[0], cap), fill, np.int32)
+            plane[:, :small.shape[1]] = small
+            full[k] = jnp.asarray(plane)
+        store.state = StringState(
+            **full,
+            prop_val=jnp.zeros((snap["count"].shape[0], cap, 1), jnp.int32),
+            count=jnp.asarray(snap["count"]),
+            overflow=jnp.asarray(snap["overflow"]))
+        store._runs = [tuple(r) for r in snap["runs"]]
+        store._run_ids = {r: i for i, r in enumerate(store._runs) if i}
+        store._client_idx = [dict(m) for m in snap["client_idx"]]
+        return store

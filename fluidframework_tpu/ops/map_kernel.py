@@ -28,6 +28,7 @@ No data-dependent control flow, fully static shapes.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Dict, List, Optional
 
 import jax
@@ -98,6 +99,60 @@ def apply_map_batch(state: MapState, kind: jax.Array, a0: jax.Array,
 
 
 apply_map_batch_jit = jax.jit(apply_map_batch, donate_argnums=0)
+
+
+@functools.partial(jax.jit, donate_argnums=0,
+                   static_argnames=("R", "O", "n_docs", "scatter_rows",
+                                    "wide_vals"))
+def map_columnar_apply_jit(state, buf, R, O, n_docs, scatter_rows,
+                           wide_vals):
+    """Fused unpack + apply of ONE byte-packed columnar map batch: the
+    host ships [kind u8 | key-slot u8 | value-handle u16/i32 | per-row
+    seq bases i32 | row indices i32] as a single int32-word buffer
+    (~4-7 B/op — each host→device transfer over a tunnel link pays the
+    RTT, so the whole batch rides one copy; see the string store's
+    ``_columnar_unpack_jit``). Per-op seqs rebuild on device from each
+    row's base (nacked slots are NOOP and consumed no seq); map merge is
+    the closed-form reduction of ``apply_map_batch``."""
+    N = R * O
+
+    def take_u8(off, n):
+        w = -(-n // 4)
+        words = jax.lax.slice_in_dim(buf, off, off + w, axis=0)
+        v = jnp.stack([words & 0xFF, (words >> 8) & 0xFF,
+                       (words >> 16) & 0xFF, (words >> 24) & 0xFF],
+                      axis=1).reshape(4 * w)[:n]
+        return v, off + w
+
+    def take_u16(off, n):
+        w = -(-n // 2)
+        words = jax.lax.slice_in_dim(buf, off, off + w, axis=0)
+        v = jnp.stack([words & 0xFFFF, (words >> 16) & 0xFFFF],
+                      axis=1).reshape(2 * w)[:n]
+        return v, off + w
+
+    def take_i32(off, n):
+        return jax.lax.slice_in_dim(buf, off, off + n, axis=0), off + n
+
+    kind, off = take_u8(0, N)
+    a0, off = take_u8(off, N)
+    a1, off = (take_i32 if wide_vals else take_u16)(off, N)
+    base, off = take_i32(off, R)
+    rows, off = take_i32(off, R)
+
+    kind = kind.reshape(R, O)
+    a0 = a0.reshape(R, O)
+    a1 = a1.reshape(R, O)
+    valid = kind != int(OpKind.NOOP)
+    seq = base[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1)
+    planes = (kind, a0, a1, seq)
+    if scatter_rows:
+        def full(p, fill):
+            return jnp.full((n_docs, O), fill, jnp.int32).at[rows].set(p)
+
+        planes = (full(kind, int(OpKind.NOOP)), full(a0, 0), full(a1, 0),
+                  full(seq, 0))
+    return apply_map_batch(state, *planes)
 
 
 def map_state_digest(state: MapState) -> jax.Array:

@@ -49,6 +49,7 @@ class OpKind(enum.IntEnum):
     # counter
     COUNTER_INCREMENT = 11  # a0=delta
     NOOP = 12         # heartbeat: advances client ref_seq for MSN only
+    AXIS_RESOLVE = 13  # matrix axis query: a0=pos → (run, off), no mutation
 
 
 N_OP_FIELDS = 9

@@ -448,6 +448,8 @@ class TensorStringStore(StringOpInterner):
         self._interval_counter = 0
         #: wire profile of the last columnar batch (None before the first)
         self.last_profile: Optional[tuple] = None
+        #: fused device→host gathers served (the read-path RTT budget)
+        self.device_reads = 0
         # highest collaboration-window floor seen per doc (anchor slides
         # trigger at its advances, matching the oracle's zamboni timing)
         self._iv_min_seq = np.zeros((self.n_docs,), np.int64)
@@ -752,11 +754,12 @@ class TensorStringStore(StringOpInterner):
                 if smaller <= tile and local_docs % smaller == 0:
                     tile = smaller
                     break
-        # VMEM budget scales with tile×capacity (7 planes + temporaries
-        # ≈ 28 B per slot): T=128 at S=384 fits the 16M scoped limit,
-        # S=512 needs T=64 (measured OOM at 19.5M otherwise)
+        # VMEM budget scales with tile×capacity. Calibrated from the
+        # compiler: T=128 at S=512 allocates 19.54M scoped (≈300 B per
+        # tile×slot incl. temporaries) vs the 16M limit, while T=128 at
+        # S=384 (≈14.7M) fits. Halve the tile until under budget.
         while (tile is not None and tile > 8
-               and tile * self.capacity * 28 > 14 * 1024 * 1024):
+               and tile * self.capacity * 300 > 15_500_000):
             nxt = tile // 2
             if local_docs % nxt != 0:
                 break
@@ -806,7 +809,10 @@ class TensorStringStore(StringOpInterner):
         """One fused device→host gather of a doc's read planes (each
         separate plane pull pays a full device round-trip — ruinous over a
         tunnel link): (removed_seq, handle_op, handle_off, length, seq)
-        trimmed to the doc's slot count."""
+        trimmed to the doc's slot count. ``device_reads`` counts these —
+        the read path's round-trip budget is asserted from it."""
+        self.device_reads = getattr(self, "device_reads", 0) + 1
+        # (getattr: restore() builds stores via __new__)
         arr = np.asarray(_gather_doc_jit(self.state, doc))
         n = int(arr[5, 0])
         return tuple(arr[i, :n] for i in range(5))
@@ -1256,6 +1262,7 @@ class TensorStringStore(StringOpInterner):
                                     [{} for _ in range(n_docs)])]
         store._interval_counter = snap.get("interval_counter", 0)
         store.last_profile = None
+        store.device_reads = 0
         store._iv_min_seq = np.asarray(
             snap.get("iv_min_seq", [0] * n_docs), np.int64)
         store._iv_tombs = [[] for _ in range(n_docs)]

@@ -28,15 +28,23 @@ from ..core.protocol import MessageType
 
 class _Session:
     """One accepted socket: reads frames, routes to the service, forwards
-    the broadcast stream through an outbound queue (order-preserving)."""
+    the broadcast stream through a BOUNDED outbound queue
+    (order-preserving). A client that cannot drain its broadcast stream
+    (dead TCP peer, stalled reader) would otherwise grow the queue without
+    bound and stall the whole fan-out on its memory — the slow-client
+    policy is EVICTION: when the queue is full the session is closed with
+    a diagnostic, exactly the reference Broadcaster's slow-consumer
+    disconnect. The client reconnects and catches up via ``deltas``."""
 
-    def __init__(self, server: "AlfredServer", reader, writer):
+    def __init__(self, server: "AlfredServer", reader, writer,
+                 max_outbound: int = 4096):
         self.server = server
         self.reader = reader
         self.writer = writer
         self.conn: Optional[DeltaConnection] = None
-        self.out: asyncio.Queue = asyncio.Queue()
+        self.out: asyncio.Queue = asyncio.Queue(maxsize=max_outbound)
         self._nacks_seen = 0
+        self._evicted = False
 
     async def run(self) -> None:
         sender = asyncio.create_task(self._send_loop())
@@ -71,7 +79,18 @@ class _Session:
             await self.writer.drain()
 
     def _push(self, obj: dict) -> None:
-        self.out.put_nowait(wire.encode_frame(obj))
+        if self._evicted:
+            return
+        try:
+            self.out.put_nowait(wire.encode_frame(obj))
+        except asyncio.QueueFull:
+            # slow-client policy: evict rather than buffer unboundedly —
+            # closing the transport breaks the read loop, which
+            # disconnects the service connection; the client's reconnect
+            # path resyncs via deltas
+            self._evicted = True
+            self.server.evictions += 1
+            self.writer.close()
 
     async def _error(self, message: str) -> None:
         """Deliver an error frame DIRECTLY (the sender task is about to be
@@ -142,10 +161,13 @@ class AlfredServer:
     """Asyncio TCP ingress in front of a LocalService pipeline."""
 
     def __init__(self, service: Optional[LocalService] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_outbound: int = 4096):
         self.service = service if service is not None else LocalService()
         self.host = host
         self.port = port
+        self.max_outbound = max_outbound
+        self.evictions = 0  # slow-client disconnects (observability)
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
@@ -154,7 +176,8 @@ class AlfredServer:
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def _accept(self, reader, writer) -> None:
-        await _Session(self, reader, writer).run()
+        await _Session(self, reader, writer,
+                       max_outbound=self.max_outbound).run()
 
     async def serve_forever(self) -> None:
         await self.start()

@@ -120,13 +120,15 @@ def encode_columnar(rec) -> bytes:
 
     for f in _COL_FIELDS:
         parts.append(plane_bytes(getattr(rec, f)))
-    # extras: per-op payload/annotate tables + the tidx plane. A record
-    # with none of them ends exactly after the 9 planes.
-    if rec.texts is not None or rec.props is not None:
-        extras = json.dumps({"texts": rec.texts,
-                             "props": rec.props}).encode()
-        parts.append(struct.pack("<q", len(extras)))
-        parts.append(extras)
+    # extras: payload/annotate/map tables + op family; the tidx plane
+    # follows only when present (has_tidx)
+    extras = json.dumps({"texts": rec.texts, "props": rec.props,
+                         "family": rec.family, "keys": rec.keys,
+                         "values": rec.values,
+                         "has_tidx": rec.tidx is not None}).encode()
+    parts.append(struct.pack("<q", len(extras)))
+    parts.append(extras)
+    if rec.tidx is not None:
         parts.append(plane_bytes(rec.tidx))
     return b"".join(parts)
 
@@ -155,16 +157,21 @@ def decode_columnar(data: bytes, widths: bool = True):
     planes = {}
     for f in _COL_FIELDS:
         planes[f], off = take_plane(off)
-    texts = props = tidx = None
+    texts = props = tidx = keys = values = None
+    family = "str"
     if off < len(data):  # extras present
         (elen,) = struct.unpack_from("<q", data, off)
         off += 8
         extras = json.loads(data[off:off + elen])
         off += elen
         texts, props = extras["texts"], extras["props"]
-        tidx, off = take_plane(off)
+        family = extras.get("family", "str")
+        keys, values = extras.get("keys"), extras.get("values")
+        if extras.get("has_tidx", True):  # legacy v3: tidx follows
+            tidx, off = take_plane(off)
     return ColumnarOps(doc_ids=doc_ids, text=text, timestamp=ts,
-                       texts=texts, props=props, tidx=tidx, **planes)
+                       texts=texts, props=props, tidx=tidx, family=family,
+                       keys=keys, values=values, **planes)
 
 
 def encode_message(msg: SequencedDocumentMessage) -> bytes:

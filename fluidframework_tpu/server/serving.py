@@ -78,14 +78,20 @@ class ColumnarOps:
     ref_seq: np.ndarray         # (N,)
     seq: np.ndarray             # (N,)
     min_seq: np.ndarray         # (N,)
-    kind: np.ndarray            # (N,) OpKind (STR_INSERT/REMOVE/ANNOTATE)
-    a0: np.ndarray              # (N,)
-    a1: np.ndarray              # (N,)
-    text: str                   # broadcast insert payload
+    kind: np.ndarray            # (N,) OpKind
+    a0: np.ndarray              # (N,) str: pos/start; map: key index
+    a1: np.ndarray              # (N,) str: len/end; map: value index
+    text: str                   # broadcast insert payload (str family)
     timestamp: float = 0.0
     texts: Optional[List[str]] = None      # per-op payload table
     props: Optional[List[dict]] = None     # single-key annotate table
     tidx: Optional[np.ndarray] = None      # (N,) table index per op
+    #: which DDS wire dialect ``expand`` rebuilds: "str" (merge-tree
+    #: ops), "map" (set/delete/clear over the keys/values tables), or
+    #: "ops" (generic op-dict batch riding the values table)
+    family: str = "str"
+    keys: Optional[List[str]] = None       # map: key table (a0 indexes)
+    values: Optional[list] = None          # map: value table (a1 indexes)
 
     def expand(self, only_doc: Optional[str] = None):
         """Per-op SequencedDocumentMessage stream (log-tail replay).
@@ -100,7 +106,20 @@ class ColumnarOps:
         out = []
         for i in idxs:
             k = int(self.kind[i])
-            if k == OpKind.STR_INSERT:
+            if self.family in ("ops", "tree"):
+                # generic op-dict batch: contents ride the values table
+                contents = self.values[int(self.a0[i])]
+            elif self.family == "map":
+                if k == OpKind.MAP_CLEAR:
+                    contents = {"op": "clear"}
+                elif k == OpKind.MAP_DELETE:
+                    contents = {"op": "delete",
+                                "key": self.keys[int(self.a0[i])]}
+                else:
+                    contents = {"op": "set",
+                                "key": self.keys[int(self.a0[i])],
+                                "value": self.values[int(self.a1[i])]}
+            elif k == OpKind.STR_INSERT:
                 text = self.text if self.texts is None \
                     else self.texts[int(self.tidx[i])]
                 contents = {"mt": "insert", "kind": 0, "pos": int(self.a0[i]),
@@ -191,6 +210,31 @@ class ServingEngineBase:
                 raise KeyError(f"document capacity {self.n_docs} exhausted")
             self._doc_rows[doc_id] = row
         return self._doc_rows[doc_id]
+
+    # ------------------------------------------- columnar-ingest row caches
+
+    def _init_row_caches(self, n_docs: int) -> None:
+        """doc id / native sequencer handle / log partition by row —
+        filled as rows are allocated; engines with a columnar ingest path
+        call this from __init__ and populate in their ``doc_row``."""
+        self._row_doc_id: List[Optional[str]] = [None] * n_docs
+        self._row_handle = np.full(n_docs, -1, np.int32)
+        self._row_part = np.zeros(n_docs, np.int32)
+
+    def _note_row(self, doc_id: str, row: int) -> None:
+        if self._row_doc_id[row] is None:
+            self._row_doc_id[row] = doc_id
+            self._row_part[row] = partition_of(doc_id, self.log.n_partitions)
+
+    def _fill_row_handles(self, rows: np.ndarray, raw) -> None:
+        if (self._row_handle[rows] < 0).any():
+            for r in rows:
+                if self._row_handle[r] < 0:
+                    if self._row_doc_id[r] is None:
+                        raise KeyError(
+                            f"row {int(r)} has no document (allocate via "
+                            "doc_row before columnar ingest)")
+                    self._row_handle[r] = raw.doc_handle(self._row_doc_id[r])
 
     def connect(self, doc_id: str, client_id: int
                 ) -> SequencedDocumentMessage:
@@ -376,11 +420,7 @@ class StringServingEngine(ServingEngineBase):
         runs as a collective-free shard_map of the same kernels."""
         super().__init__(batch_window, n_partitions, compact_every, log,
                          sequencer=sequencer)
-        # columnar-ingest row caches (doc id / native handle / partition by
-        # flat-tier row), filled as rows are allocated
-        self._row_doc_id: List[Optional[str]] = [None] * n_docs
-        self._row_handle = np.full(n_docs, -1, np.int32)
-        self._row_part = np.zeros(n_docs, np.int32)
+        self._init_row_caches(n_docs)
         if store is not None and mesh is not None \
                 and getattr(store, "mesh", None) is not mesh:
             raise ValueError("mesh given with a store that is not sharded "
@@ -433,9 +473,7 @@ class StringServingEngine(ServingEngineBase):
         if doc_id in self._mega_rows:
             return self._mega_rows[doc_id]
         row = super().doc_row(doc_id)
-        if self._row_doc_id[row] is None:
-            self._row_doc_id[row] = doc_id
-            self._row_part[row] = partition_of(doc_id, self.log.n_partitions)
+        self._note_row(doc_id, row)
         return row
 
     def mark_mega(self, doc_id: str) -> None:
@@ -598,9 +636,10 @@ class StringServingEngine(ServingEngineBase):
         self.flush()  # per-op queue first: per-doc seq order must hold
         rows = np.ascontiguousarray(rows, np.int32)
         R, O = kind.shape
-        if len(np.unique(rows)) != R:
-            raise ValueError("duplicate rows in columnar batch (the device "
-                             "scatter would silently drop ops)")
+        if len(rows) != R or len(np.unique(rows)) != R:
+            raise ValueError("rows must be exactly one UNIQUE row per "
+                             "plane row (duplicates would silently drop "
+                             "ops in the device scatter)")
         if self._graduated and any(self._row_doc_id[r] in self._graduated
                                    for r in rows):
             raise ValueError("a targeted doc has graduated off the flat "
@@ -642,14 +681,7 @@ class StringServingEngine(ServingEngineBase):
         elif texts is not None or props is not None:
             raise ValueError("payload/props tables require the tidx plane")
 
-        if (self._row_handle[rows] < 0).any():  # fill handle cache once
-            for r in rows:
-                if self._row_handle[r] < 0:
-                    if self._row_doc_id[r] is None:
-                        raise KeyError(
-                            f"row {int(r)} has no document (allocate via "
-                            "doc_row before columnar ingest)")
-                    self._row_handle[r] = raw.doc_handle(self._row_doc_id[r])
+        self._fill_row_handles(rows, raw)
 
         t0 = time.perf_counter()
         flat = lambda p: np.ascontiguousarray(np.asarray(p, np.int32)
@@ -1142,11 +1174,173 @@ class MapServingEngine(ServingEngineBase):
     def __init__(self, n_docs: int, n_keys: int = 64,
                  batch_window: int = 64, n_partitions: int = 8,
                  log: Optional[PartitionedLog] = None,
-                 store: Optional[TensorMapStore] = None):
-        super().__init__(batch_window, n_partitions, log=log)
+                 store: Optional[TensorMapStore] = None,
+                 sequencer: str = "python"):
+        super().__init__(batch_window, n_partitions, log=log,
+                         sequencer=sequencer)
         self.store = store if store is not None \
             else TensorMapStore(n_docs, n_keys)
         self.n_docs = n_docs
+        self._init_row_caches(n_docs)
+        self._col_part = 0
+        # per-(rows, key-vocabulary) key-slot lut cache: steady-state
+        # ingest with a stable vocabulary pays zero interning dict hits
+        self._lut_cache: Optional[tuple] = None
+
+    def doc_row(self, doc_id: str) -> int:
+        row = super().doc_row(doc_id)
+        self._note_row(doc_id, row)
+        return row
+
+    # ------------------------------------------------------- columnar ingest
+
+    def _key_lut(self, rows: np.ndarray, keys: List[str]) -> np.ndarray:
+        """(R, K) per-row key→slot table for this batch's key vocabulary
+        (mints slots — KeyError on capacity BEFORE anything is sequenced)."""
+        ck = (tuple(keys), rows.tobytes())
+        if self._lut_cache is not None and self._lut_cache[0] == ck:
+            return self._lut_cache[1]
+        lut = np.empty((len(rows), len(keys)), np.int32)
+        for i, r in enumerate(rows):
+            for j, k in enumerate(keys):
+                lut[i, j] = self.store.key_slot(int(r), k)
+        self._lut_cache = (ck, lut)
+        return lut
+
+    def ingest_planes(self, rows, client, client_seq, ref_seq, kind,
+                      kidx, keys: List[str], values: Optional[list] = None,
+                      vidx=None) -> dict:
+        """High-throughput map ingest: a dense (R, O) columnar batch of
+        RAW set/delete/clear ops — one native sequencing call, ONE
+        whole-batch durable-log record (family "map"), one fused
+        unpack+apply device dispatch (~4-7 B/op on the wire).
+
+        kidx: (R, O) indices into ``keys`` (ignored at clear slots).
+        values/vidx: value table + (R, O) indices for set slots.
+        Same contract as the string engine's ``ingest_planes``: nacked
+        slots are skipped everywhere; returns {"seq", "nacked"}."""
+        self._check_poisoned()
+        raw = getattr(self.deli, "raw", None)
+        if raw is None:
+            raise RuntimeError("columnar ingest requires sequencer='native'")
+        self.flush()
+        rows = np.ascontiguousarray(rows, np.int32)
+        R, O = kind.shape
+        if len(rows) != R or len(np.unique(rows)) != R:
+            raise ValueError("rows must be exactly one UNIQUE row per "
+                             "plane row")
+        kind = np.asarray(kind, np.int32)
+        allowed = [int(OpKind.MAP_SET), int(OpKind.MAP_DELETE),
+                   int(OpKind.MAP_CLEAR)]
+        if not np.isin(kind, allowed).all():
+            raise ValueError("columnar map planes must be dense "
+                             "set/delete/clear")
+        if self.store.n_keys > 256:
+            raise ValueError("columnar map ingest packs key slots as u8 "
+                             "(store n_keys must be <= 256)")
+        kidx = np.asarray(kidx, np.int32)
+        keyed = kind != int(OpKind.MAP_CLEAR)
+        if keyed.any() and (int(kidx[keyed].min()) < 0
+                            or int(kidx[keyed].max()) >= len(keys)):
+            raise ValueError("kidx beyond the keys table")
+        sets = kind == int(OpKind.MAP_SET)
+        if sets.any():
+            if values is None or vidx is None:
+                raise ValueError("set slots require values + vidx")
+            vidx = np.asarray(vidx, np.int32)
+            if int(vidx[sets].min()) < 0 or \
+                    int(vidx[sets].max()) >= len(values):
+                raise ValueError("vidx beyond the values table")
+        # mint key slots + value handles BEFORE sequencing (capacity
+        # failures must reject the batch with nothing acked)
+        lut = self._key_lut(rows, keys)
+        kidx_safe = np.where(keyed, kidx, 0)  # ignored slots may carry
+        a0 = np.where(keyed,                  # garbage per the contract
+                      lut[np.arange(R)[:, None], kidx_safe], 0)
+        if sets.any():
+            handles_tab = np.fromiter(
+                (self.store.value_handle(v) for v in values), np.int32,
+                count=len(values))
+            a1 = np.where(sets, handles_tab[np.where(sets, vidx, 0)], 0)
+        else:
+            a1 = np.zeros((R, O), np.int32)
+
+        self._fill_row_handles(rows, raw)
+        t0 = time.perf_counter()
+        flat = lambda p: np.ascontiguousarray(np.asarray(p, np.int32)
+                                              .reshape(-1))
+        handles = np.repeat(self._row_handle[rows], O)
+        out_seq, out_min = raw.sequence_batch_rows(
+            handles, flat(client), flat(client_seq), flat(ref_seq))
+        self._poisoned = "columnar batch failed after sequencing"
+        nacked = out_seq < 0
+        n_ok = int((~nacked).sum())
+        self.metrics.inc("ops_ingested", n_ok)
+        if nacked.any():
+            self.metrics.inc("nacks", int(nacked.sum()))
+        valid_rs = (~nacked).reshape(R, O)
+        kind_eff = np.where(valid_rs, kind, int(OpKind.NOOP))
+        seq_rs = out_seq.reshape(R, O)
+        n_valid = valid_rs.sum(axis=1)
+        seq_base = (np.max(np.where(valid_rs, seq_rs, 0), axis=1)
+                    - n_valid).astype(np.int32)
+
+        # device merge (async dispatch): byte-packed single buffer
+        def seg_u8(arr):
+            b = np.ascontiguousarray(arr, np.uint8).reshape(-1)
+            if len(b) % 4:
+                b = np.concatenate([b, np.zeros((-len(b)) % 4, np.uint8)])
+            return b.view("<i4")
+
+        def seg_u16(arr):
+            b = np.ascontiguousarray(arr, "<u2").reshape(-1)
+            if len(b) % 2:
+                b = np.concatenate([b, np.zeros(1, "<u2")])
+            return b.view("<i4")
+
+        wide_vals = bool(int(a1.max(initial=0)) >= (1 << 16))
+        buf = np.concatenate([
+            seg_u8(kind_eff), seg_u8(a0),
+            (np.ascontiguousarray(a1, "<i4").reshape(-1) if wide_vals
+             else seg_u16(a1)),
+            seq_base.astype("<i4"),
+            rows.astype("<i4"),
+        ])
+        from ..ops.map_kernel import map_columnar_apply_jit
+        scatter = not (R == self.n_docs
+                       and np.array_equal(rows, np.arange(R)))
+        import jax.numpy as jnp
+        self.store.state = map_columnar_apply_jit(
+            self.store.state, jnp.asarray(buf), R=R, O=O,
+            n_docs=self.n_docs, scatter_rows=scatter, wide_vals=wide_vals)
+
+        # whole-batch durable record (host work rides under the device
+        # apply); nacked batches fall back to per-partition grouping is
+        # unnecessary here: map records carry their tables per record
+        ts = self.deli.clock()
+        rowidx = np.repeat(np.arange(R, dtype=np.int32), O)
+        ids = [self._row_doc_id[r] for r in rows]
+        ref_clamped = np.minimum(flat(ref_seq).astype(np.int64),
+                                 np.maximum(out_seq - 1, 0))
+        ok = ~nacked
+        p = self._col_part
+        self._col_part = (p + 1) % self.log.n_partitions
+        self.log.append(int(p), ColumnarOps(
+            ids, rowidx[ok], flat(client)[ok], flat(client_seq)[ok],
+            ref_clamped[ok], out_seq[ok], out_min[ok],
+            kind.reshape(-1)[ok], flat(kidx)[ok],
+            (flat(vidx) if vidx is not None
+             else np.zeros(R * O, np.int32))[ok],
+            text="", timestamp=ts, family="map", keys=list(keys),
+            values=list(values) if values is not None else []))
+        self._poisoned = None
+        last_min = out_min.reshape(R, O)[:, -1]
+        for i, r in enumerate(rows):
+            self._min_seq[self._row_doc_id[r]] = int(last_min[i])
+        self.metrics.inc("flushes")
+        self.metrics.inc("ops_flushed", n_ok)
+        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        return {"seq": seq_rs, "nacked": int(nacked.sum())}
 
     # ----------------------------------------------------------- device side
 
@@ -1216,41 +1410,63 @@ class MapServingEngine(ServingEngineBase):
 class MatrixServingEngine(ServingEngineBase):
     """Serving engine for SharedMatrix documents.
 
-    Division of labor (SURVEY.md §2.4): the thin permutation logic — row/col
-    inserts/removes and position→key resolution at each op's (ref_seq,
-    client) perspective — runs on host observer axes (MergeTree-backed,
-    exactly the DDS's rules); the cell-write volume merges on device in the
-    sort-based cell table, shared across all documents by interning
-    (doc, rowKey, colKey) identities.
+    Division of labor (SURVEY.md §2.4), fully on device as of r4: the
+    permutation state (row/col axes) lives in the batched merge-tree
+    kernel (``TensorAxisStore``, 2 axis rows per doc), and position→key
+    resolution at each op's (ref_seq, client) perspective happens INSIDE
+    the same device scan that applies the axis mutations (the
+    ``AXIS_RESOLVE`` op) — one dispatch + ONE device→host read per
+    flush, instead of a host MergeTree walk per op. The cell-write
+    volume merges in the sort-based device cell table, shared across
+    documents by interning (doc, rowKey, colKey) identities.
 
-    FWW fidelity: the DDS's first-writer-wins rejects a write only when the
-    writer had NOT seen the current value and is not its author — unlike
-    the kernel's batch-level "first ever wins" flag. The engine therefore
-    tracks per-cell (seq, writer) host-side and filters FWW losers BEFORE
-    device apply; the device always merges LWW, and the surviving stream's
-    latest write is exactly the DDS's answer.
+    FWW fidelity: the DDS's first-writer-wins rejects a write only when
+    the writer had NOT seen the current value and is not its author —
+    unlike the kernel's batch-level "first ever wins" flag. The engine
+    tracks per-cell (seq, writer) host-side and filters FWW losers on
+    the RESOLVED key stream before the cell apply; the device always
+    merges LWW, and the surviving stream's latest write is exactly the
+    DDS's answer.
     """
 
     _MX = {"insRow", "insCol", "rmRow", "rmCol", "setCell", "policy"}
 
+    #: latest-view perspective for reads (every acked op visible)
+    _READ_REF = 1 << 30
+
     def __init__(self, n_docs: int, cell_capacity: int = 1 << 16,
                  batch_window: int = 64, n_partitions: int = 8,
                  log: Optional[PartitionedLog] = None,
-                 store=None):
+                 store=None, axis_capacity: int = 256,
+                 axis_store=None, sequencer: str = "python"):
+        from ..ops.axis_kernel import TensorAxisStore
         from ..ops.matrix_kernel import TensorMatrixStore
-        super().__init__(batch_window, n_partitions, log=log)
+        super().__init__(batch_window, n_partitions, log=log,
+                         sequencer=sequencer)
         self.store = store if store is not None \
             else TensorMatrixStore(cell_capacity)
+        self.axis_store = axis_store if axis_store is not None \
+            else TensorAxisStore(n_docs, axis_capacity)
         self.n_docs = n_docs
-        self._axes: Dict[int, tuple] = {}       # row -> (rows, cols)
         self._fww: Dict[int, bool] = {}
         # per-doc {cell: (seq, writer)} — the FWW visibility metadata
         self._cell_meta: Dict[int, Dict] = {}
         self._pending_setcells = 0  # queued setCells (capacity reservation)
+        self._init_row_caches(n_docs)
+        self._col_part = 0
+        # conservative per-axis slot usage bound (each admitted axis op
+        # adds at most 2 slots: an insert, or a remove's two splits);
+        # re-based to the measured device counts at every compact()
+        self._axis_used = np.zeros(2 * n_docs, np.int64)
 
     # structural bound on one axis op (an insert allocates count slots on
-    # the host axis — an unbounded count is a memory-exhaustion vector)
+    # the axis — an unbounded count is a memory-exhaustion vector)
     MAX_AXIS_COUNT = 1 << 20
+
+    def doc_row(self, doc_id: str) -> int:
+        row = super().doc_row(doc_id)
+        self._note_row(doc_id, row)
+        return row
 
     def _valid_op(self, contents: Any) -> bool:
         """Full structural validation BEFORE sequencing/logging: every field
@@ -1285,6 +1501,16 @@ class MatrixServingEngine(ServingEngineBase):
 
     def _admit(self, doc_id: str, contents: Any) -> None:
         super()._admit(doc_id, contents)
+        if contents["mx"] in ("insRow", "insCol", "rmRow", "rmCol"):
+            # device axis rows are fixed-capacity: an acked axis op the
+            # kernel must drop (sticky overflow) would silently corrupt
+            # dims/cells — nack at admission when the conservative bound
+            # says the axis may not fit it
+            row = self.doc_row(doc_id)
+            axis = 2 * row + (1 if contents["mx"].endswith("Col") else 0)
+            if self._axis_used[axis] + 2 > self.axis_store.capacity:
+                raise KeyError("axis slot capacity exhausted")
+            self._axis_used[axis] += 2
         if contents["mx"] == "setCell":
             # conservative cell-capacity reservation: distinct interned
             # identities never shrink, and each queued setCell may mint one
@@ -1295,109 +1521,308 @@ class MatrixServingEngine(ServingEngineBase):
                 raise KeyError("cell table capacity exhausted")
             self._pending_setcells += 1
 
-    def _axes_for(self, row: int) -> tuple:
-        if row not in self._axes:
-            from ..models.shared_matrix import _Axis
-            from ..core.constants import NO_CLIENT
-            self._axes[row] = (_Axis(NO_CLIENT), _Axis(NO_CLIENT))
-            self._fww[row] = False
-            self._cell_meta[row] = {}
-        return self._axes[row]
-
     # ----------------------------------------------------------- device side
 
+    @staticmethod
+    def _mixed(op_key) -> int:
+        """The oracle's run identity mix (models/shared_matrix.py:55)."""
+        return op_key[0] * 1_000_003 + op_key[1]
+
     def _flush_impl(self) -> int:
-        """Walk the window in seq order: permutation ops advance the host
-        axes, setCells resolve to stable keys (and pass the FWW filter),
-        then ONE device merge applies the surviving cell writes."""
+        """Batch the window into per-axis-row op planes — axis mutations
+        AND setCell position resolves in one scan — then FWW-filter the
+        resolved key stream and merge the surviving cell writes. Exactly
+        one device dispatch + one device→host read per flush."""
         n = len(self._queue)
         if not n:
             return n
         self._queue.sort(key=lambda dm: dm[1].seq)
-        records = []
+        per_axis: Dict[int, list] = {}
+        setcells = []  # (row, msg, r_slot, c_slot)
+        dropped = set()
         for row, msg in self._queue:
+            op = msg.contents
+            mx = op["mx"]
+            self._fww.setdefault(row, False)
+            self._cell_meta.setdefault(row, {})
+            ar, ac = 2 * row, 2 * row + 1
             try:
-                self._apply_one(row, msg, records)
-            except (IndexError, KeyError):
-                # an op referencing positions that do not exist at its own
-                # (ref_seq, client) perspective is a protocol violation by
-                # the submitter; dropping it keeps the server (and its
-                # recovery replay) alive — it can never become applyable
-                pass
-        self._queue.clear()
+                self.axis_store.client(ar, msg.client_id)
+                self.axis_store.client(ac, msg.client_id)
+            except KeyError:
+                # per-axis client capacity (MAX_CLIENTS): drop the op —
+                # the old host-axis path dropped per-op failures too
+                dropped.add(id(msg))
+                continue
+            if mx in ("insRow", "insCol"):
+                axis = ar if mx == "insRow" else ac
+                run = self.axis_store.run_handle(
+                    self._mixed(tuple(op["opKey"])), op.get("off", 0))
+                per_axis.setdefault(axis, []).append(
+                    (int(OpKind.STR_INSERT), op["pos"], op["count"], run,
+                     msg.seq, self.axis_store.client(axis, msg.client_id),
+                     msg.ref_seq))
+            elif mx in ("rmRow", "rmCol"):
+                axis = ar if mx == "rmRow" else ac
+                per_axis.setdefault(axis, []).append(
+                    (int(OpKind.STR_REMOVE), op["start"],
+                     op["start"] + op["count"], 0, msg.seq,
+                     self.axis_store.client(axis, msg.client_id),
+                     msg.ref_seq))
+            elif mx == "setCell":
+                rl = per_axis.setdefault(ar, [])
+                cl = per_axis.setdefault(ac, [])
+                rl.append((int(OpKind.AXIS_RESOLVE), op["row"], 0, 0,
+                           msg.seq,
+                           self.axis_store.client(ar, msg.client_id),
+                           msg.ref_seq))
+                cl.append((int(OpKind.AXIS_RESOLVE), op["col"], 0, 0,
+                           msg.seq,
+                           self.axis_store.client(ac, msg.client_id),
+                           msg.ref_seq))
+                setcells.append((row, msg, len(rl) - 1, len(cl) - 1))
+            # "policy" flips are applied in the seq-ordered filter below
         self._pending_setcells = 0
-        if records:
-            self.store.apply_batch(records)
-        return n
 
-    def overflowed(self) -> bool:
-        """Sticky device-table overflow flag (should stay False: admission
-        reserves capacity; True means re-bucket with a larger table)."""
-        return self.store.overflowed()
+        rh = ro = None
+        if per_axis:
+            rh, ro = self._dispatch_axis(per_axis)
 
-    def _apply_one(self, row: int, msg: SequencedDocumentMessage,
-                   records: list) -> None:
-        op = msg.contents
-        mx = op["mx"]
-        rows, cols = self._axes_for(row)
-        if mx in ("insRow", "insCol"):
-            axis = rows if mx == "insRow" else cols
-            axis.insert(op["pos"], op["count"], tuple(op["opKey"]),
-                        msg.seq, msg.client_id, msg.ref_seq,
-                        local_op=None, key_offset=op.get("off", 0))
-        elif mx in ("rmRow", "rmCol"):
-            axis = rows if mx == "rmRow" else cols
-            axis.remove(op["start"], op["count"], msg.seq,
-                        msg.client_id, msg.ref_seq, local_op=None)
-        elif mx == "policy":
-            self._fww[row] = True
-        else:  # setCell
-            rk = rows.resolve(op["row"], msg.ref_seq, msg.client_id)
-            ck = cols.resolve(op["col"], msg.ref_seq, msg.client_id)
+        # seq-ordered pass: policy flips + FWW filter on resolved keys
+        records = []
+        sc_i = 0
+        for row, msg in self._queue:
+            op = msg.contents
+            if id(msg) in dropped:
+                continue
+            if op["mx"] == "policy":
+                self._fww[row] = True
+                continue
+            if op["mx"] != "setCell":
+                continue
+            _, _, rs, cs = setcells[sc_i]
+            sc_i += 1
+            ar, ac = 2 * row, 2 * row + 1
+            if rh[ar, rs] < 0 or rh[ac, cs] < 0:
+                continue  # position out of range at the op's perspective:
+                # protocol violation by the submitter; drop (oracle raises)
+            rk = self.axis_store.run_key(int(rh[ar, rs]), int(ro[ar, rs]))
+            ck = self.axis_store.run_key(int(rh[ac, cs]), int(ro[ac, cs]))
             meta = self._cell_meta[row]
             cell = (rk, ck)
             if self._fww[row]:
                 seq, writer = meta.get(cell, (0, None))
                 if seq > msg.ref_seq and writer != msg.client_id:
-                    return  # FWW: unseen concurrent write loses
+                    continue  # FWW: unseen concurrent write loses
             meta[cell] = (msg.seq, msg.client_id)
             records.append(((row, rk), ck, op["value"], msg.seq))
+        self._queue.clear()
+        if records:
+            self.store.apply_batch(records)
+        return n
+
+    def ingest_cells(self, doc_ids: List[str], clients, client_seqs,
+                     ref_seqs, rpos, cpos, values) -> dict:
+        """High-throughput setCell ingest: N raw cell writes (op i targets
+        ``doc_ids[i]`` at row/col positions ``rpos[i]``/``cpos[i]``) —
+        ONE native sequencing call, one device axis-resolve scan (+ read),
+        the FWW filter on the resolved key stream, one cell-table merge,
+        and ONE whole-batch durable record. The volume op of BASELINE
+        config #3 without per-op Python anywhere. Axis mutations
+        (ins/rm row/col, policy) go through ``submit`` as before."""
+        self._check_poisoned()
+        raw = getattr(self.deli, "raw", None)
+        if raw is None:
+            raise RuntimeError("cell ingest requires sequencer='native'")
+        n = len(doc_ids)
+        if not (len(clients) == len(client_seqs) == len(ref_seqs)
+                == len(rpos) == len(cpos) == len(values) == n):
+            raise ValueError("batch fields must have equal length")
+        try:  # the log and the value interner both JSON-encode values:
+            json.dumps(values)  # reject unserializable BEFORE sequencing
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"unserializable cell value: {e}") from None
+        rpos = np.ascontiguousarray(rpos, np.int32)
+        cpos = np.ascontiguousarray(cpos, np.int32)
+        if len(rpos) and (int(rpos.min()) < 0 or int(cpos.min()) < 0):
+            raise ValueError("negative cell position")
+        self.flush()  # per-op queue first: per-doc seq order must hold
+        rows = np.fromiter((self.doc_row(d) for d in doc_ids), np.int32,
+                           count=n)
+        if len(self.store._cell_ids) + n >= self.store.capacity:
+            raise KeyError("cell table capacity exhausted")
+        client = np.ascontiguousarray(clients, np.int32)
+        for i in range(n):  # mint axis client slots BEFORE sequencing
+            row = int(rows[i])  # (capacity failure must reject the batch)
+            self.axis_store.client(2 * row, int(client[i]))
+            self.axis_store.client(2 * row + 1, int(client[i]))
+        self._fill_row_handles(np.unique(rows), raw)
+        t0 = time.perf_counter()
+        cseq = np.ascontiguousarray(client_seqs, np.int32)
+        ref = np.ascontiguousarray(ref_seqs, np.int32)
+        out_seq, out_min = raw.sequence_batch_rows(
+            self._row_handle[rows], client, cseq, ref)
+        self._poisoned = "cell batch failed after sequencing"
+        nacked = out_seq < 0
+        n_ok = int((~nacked).sum())
+        self.metrics.inc("ops_ingested", n_ok)
+        if nacked.any():
+            self.metrics.inc("nacks", int(nacked.sum()))
+        ok = np.flatnonzero(~nacked)
+
+        # one resolve-only axis scan for every accepted op
+        per_axis: Dict[int, list] = {}
+        slots = []
+        for i in ok:
+            row = int(rows[i])
+            ar, ac = 2 * row, 2 * row + 1
+            rl = per_axis.setdefault(ar, [])
+            cl_ = per_axis.setdefault(ac, [])
+            rl.append((int(OpKind.AXIS_RESOLVE), int(rpos[i]), 0, 0,
+                       int(out_seq[i]),
+                       self.axis_store.client(ar, int(client[i])),
+                       int(ref[i])))
+            cl_.append((int(OpKind.AXIS_RESOLVE), int(cpos[i]), 0, 0,
+                       int(out_seq[i]),
+                       self.axis_store.client(ac, int(client[i])),
+                       int(ref[i])))
+            slots.append((ar, len(rl) - 1, ac, len(cl_) - 1))
+        records = []
+        contents_tab = []
+        if per_axis:
+            rh, ro = self._dispatch_axis(per_axis)
+            for j, i in enumerate(ok):
+                row = int(rows[i])
+                ar, rs, ac, cs = slots[j]
+                contents_tab.append(
+                    {"mx": "setCell", "row": int(rpos[i]),
+                     "col": int(cpos[i]), "value": values[i]})
+                if rh[ar, rs] < 0 or rh[ac, cs] < 0:
+                    continue  # out of range at perspective: drop
+                rk = self.axis_store.run_key(int(rh[ar, rs]),
+                                             int(ro[ar, rs]))
+                ck = self.axis_store.run_key(int(rh[ac, cs]),
+                                             int(ro[ac, cs]))
+                self._fww.setdefault(row, False)
+                meta = self._cell_meta.setdefault(row, {})
+                cell = (rk, ck)
+                if self._fww[row]:
+                    sq, writer = meta.get(cell, (0, None))
+                    if sq > int(ref[i]) and writer != int(client[i]):
+                        continue
+                meta[cell] = (int(out_seq[i]), int(client[i]))
+                records.append(((row, rk), ck, values[i],
+                                int(out_seq[i])))
+        if records:
+            self.store.apply_batch(records)
+
+        # whole-batch durable record (family "ops")
+        ts = self.deli.clock()
+        id_tab = sorted(set(doc_ids))
+        id_of = {d: i for i, d in enumerate(id_tab)}
+        ref_clamped = np.minimum(ref.astype(np.int64),
+                                 np.maximum(out_seq - 1, 0))
+        p = self._col_part
+        self._col_part = (p + 1) % self.log.n_partitions
+        self.log.append(int(p), ColumnarOps(
+            id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
+                                count=len(ok)),
+            client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
+            out_min[ok], np.zeros(len(ok), np.int32),
+            np.arange(len(ok), dtype=np.int32),
+            np.zeros(len(ok), np.int32),
+            text="", timestamp=ts, family="ops", values=contents_tab))
+        self._poisoned = None
+        for i in ok:
+            self._min_seq[doc_ids[i]] = int(out_min[i])
+        self.metrics.inc("flushes")
+        self.metrics.inc("ops_flushed", n_ok)
+        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        return {"seq": out_seq, "nacked": int(nacked.sum())}
+
+    def _dispatch_axis(self, per_axis: Dict[int, list]):
+        """Dense (2·D, O) planes from per-axis op lists → one scan."""
+        widest = max(len(v) for v in per_axis.values())
+        o = 8
+        while o < widest:
+            o *= 2
+        D2 = 2 * self.n_docs
+        planes = {
+            "kind": np.full((D2, o), int(OpKind.NOOP), np.int32),
+            "a0": np.zeros((D2, o), np.int32),
+            "a1": np.zeros((D2, o), np.int32),
+            "a2": np.zeros((D2, o), np.int32),
+            "seq": np.zeros((D2, o), np.int32),
+            "client": np.zeros((D2, o), np.int32),
+            "ref_seq": np.zeros((D2, o), np.int32),
+        }
+        names = ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")
+        for axis, recs in per_axis.items():
+            for j, rec in enumerate(recs):
+                for name, v in zip(names, rec):
+                    planes[name][axis, j] = v
+        return self.axis_store.apply(planes)
+
+    def overflowed(self) -> bool:
+        """Sticky device overflow (cell table or an axis row): True means
+        re-bucket with a larger table / axis capacity."""
+        return bool(self.store.overflowed()) or \
+            bool(self.axis_store.overflowed().any())
 
     def compact(self) -> None:
-        """Zamboni the host axes at each doc's window floor."""
+        """Zamboni the device axes at each doc's window floor; re-base
+        the conservative axis-slot bound to the measured counts."""
+        self.flush()
+        ms = np.zeros((2 * self.n_docs,), np.int32)
         for doc_id, row in self._doc_rows.items():
-            if row in self._axes:
-                ms = self._min_seq.get(doc_id, 0)
-                for axis in self._axes[row]:
-                    axis.tree.zamboni(ms)
+            ms[2 * row] = ms[2 * row + 1] = self._min_seq.get(doc_id, 0)
+        self.axis_store.compact(ms)
+        self._axis_used = np.asarray(self.axis_store.state.count,
+                                     dtype=np.int64).copy()
         super().compact()
 
     # ----------------------------------------------------------------- reads
 
+    def _resolve_read(self, queries):
+        """Latest-view resolves [(axis_row, pos)] → [(run, off)] in one
+        non-mutating device dispatch."""
+        per_axis: Dict[int, list] = {}
+        slots = []
+        for axis, pos in queries:
+            lst = per_axis.setdefault(axis, [])
+            lst.append((int(OpKind.AXIS_RESOLVE), pos, 0, 0, 0, -1,
+                        self._READ_REF))
+            slots.append((axis, len(lst) - 1))
+        rh, ro = self._dispatch_axis(per_axis)
+        return [(int(rh[a, j]), int(ro[a, j])) for a, j in slots]
+
     def dims(self, doc_id: str):
         self.flush()
-        rows, cols = self._axes_for(self.doc_row(doc_id))
-        return rows.length(), cols.length()
+        row = self.doc_row(doc_id)
+        lens = self.axis_store.visible_lengths()
+        return int(lens[2 * row]), int(lens[2 * row + 1])
 
     def get_cell(self, doc_id: str, r: int, c: int):
         self.flush()
         row = self.doc_row(doc_id)
-        rows, cols = self._axes_for(row)
-        from ..models.merge_tree import LOCAL_VIEW
-        rk = rows.resolve(r, LOCAL_VIEW, rows.client_id)
-        ck = cols.resolve(c, LOCAL_VIEW, cols.client_id)
-        return self.store.read_cell(((row, rk), ck))
+        (hr, orr), (hc, oc) = self._resolve_read(
+            [(2 * row, r), (2 * row + 1, c)])
+        if hr < 0 or hc < 0:
+            raise IndexError(f"cell ({r}, {c}) out of range")
+        return self.store.read_cell(
+            ((row, self.axis_store.run_key(hr, orr)),
+             self.axis_store.run_key(hc, oc)))
 
     def to_lists(self, doc_id: str):
         self.flush()
         row = self.doc_row(doc_id)
-        rows, cols = self._axes_for(row)
-        from ..models.merge_tree import LOCAL_VIEW
+        nr, nc = self.dims(doc_id)
+        res = self._resolve_read(
+            [(2 * row, i) for i in range(nr)] +
+            [(2 * row + 1, j) for j in range(nc)])
+        rkeys = [self.axis_store.run_key(h, off) for h, off in res[:nr]]
+        ckeys = [self.axis_store.run_key(h, off) for h, off in res[nr:]]
         cells = self.store.read_cells()
-        rkeys = [rows.resolve(i, LOCAL_VIEW, rows.client_id)
-                 for i in range(rows.length())]
-        ckeys = [cols.resolve(j, LOCAL_VIEW, cols.client_id)
-                 for j in range(cols.length())]
         return [[cells.get(((row, rk), ck)) for ck in ckeys]
                 for rk in rkeys]
 
@@ -1408,9 +1833,7 @@ class MatrixServingEngine(ServingEngineBase):
         self.compact()
         summary = self._base_summary()
         summary["store"] = self.store.snapshot()
-        summary["axes"] = {
-            row: (rows.tree.summarize(), cols.tree.summarize())
-            for row, (rows, cols) in self._axes.items()}
+        summary["axis_store"] = self.axis_store.snapshot()
         summary["fww"] = dict(self._fww)
         summary["cell_meta"] = {row: list(m.items())
                                 for row, m in self._cell_meta.items()}
@@ -1420,25 +1843,17 @@ class MatrixServingEngine(ServingEngineBase):
     @classmethod
     def load(cls, summary: dict, log: PartitionedLog,
              **kwargs) -> "MatrixServingEngine":
-        from ..core.constants import NO_CLIENT
-        from ..models.merge_tree import MergeTree
-        from ..models.shared_matrix import _Axis
+        from ..ops.axis_kernel import TensorAxisStore
         from ..ops.matrix_kernel import TensorMatrixStore, tuple_key
         store = TensorMatrixStore.restore(summary["store"])
-        engine = cls(summary["n_docs"], log=log, store=store, **kwargs)
+        axis = TensorAxisStore.restore(summary["axis_store"])
+        engine = cls(summary["n_docs"], log=log, store=store,
+                     axis_store=axis, **kwargs)
         engine._restore_base(summary)
-        for row, (rsum, csum) in summary["axes"].items():
-            rows, cols = _Axis(NO_CLIENT), _Axis(NO_CLIENT)
-            rows.tree = MergeTree.load(rsum, local_client=NO_CLIENT)
-            cols.tree = MergeTree.load(csum, local_client=NO_CLIENT)
-            engine._axes[row] = (rows, cols)
         engine._fww = dict(summary["fww"])
         engine._cell_meta = {
             row: {tuple_key(cell): tuple(sw) for cell, sw in items}
             for row, items in summary["cell_meta"].items()}
-        for row in engine._axes:
-            engine._cell_meta.setdefault(row, {})
-            engine._fww.setdefault(row, False)
         engine._replay_tail(summary)
         engine.flush()
         return engine
@@ -1463,13 +1878,17 @@ class TreeServingEngine(ServingEngineBase):
     def __init__(self, n_docs: int, capacity: int = 256,
                  batch_window: int = 64, n_partitions: int = 8,
                  log: Optional[PartitionedLog] = None,
-                 store: Optional["TensorTreeStore"] = None):
+                 store: Optional["TensorTreeStore"] = None,
+                 sequencer: str = "python"):
         from ..ops.tree_store import TensorTreeStore
-        super().__init__(batch_window, n_partitions, log=log)
+        super().__init__(batch_window, n_partitions, log=log,
+                         sequencer=sequencer)
         self.store = store if store is not None \
             else TensorTreeStore(n_docs, capacity)
         self.n_docs = n_docs
         self.capacity = self.store.capacity
+        self._init_row_caches(n_docs)
+        self._col_part = 0
         # terminal tier: docs too big for the batched store, each in its
         # own single-doc store sharing the main store's interners
         self._graduated: Dict[str, Any] = {}
@@ -1549,6 +1968,11 @@ class TreeServingEngine(ServingEngineBase):
 
     # ----------------------------------------------------------- device side
 
+    def doc_row(self, doc_id: str) -> int:
+        row = super().doc_row(doc_id)
+        self._note_row(doc_id, row)
+        return row
+
     def _admit(self, doc_id: str, contents: Any) -> None:
         if doc_id not in self._graduated:
             # graduated docs own their store; don't re-pin a tier row
@@ -1575,6 +1999,87 @@ class TreeServingEngine(ServingEngineBase):
                 n += len(msgs)
                 msgs.clear()
         return n
+
+    # ------------------------------------------------------- columnar ingest
+
+    def ingest_batch(self, doc_ids: List[str], clients, client_seqs,
+                     ref_seqs, ops: List[dict]) -> dict:
+        """High-throughput tree ingest: N parallel raw edits (op i targets
+        ``doc_ids[i]``; per-doc order = list order) — ONE native
+        sequencing call, ONE whole-batch durable record (family "tree",
+        the op dicts riding the record's ``values`` table), one batched
+        device apply at flush. Nacked slots are skipped everywhere.
+        Returns {"seq": (N,) int64 (negative = nack code), "nacked"}."""
+        self._check_poisoned()
+        raw = getattr(self.deli, "raw", None)
+        if raw is None:
+            raise RuntimeError("batch ingest requires sequencer='native'")
+        n = len(ops)
+        if not (len(doc_ids) == len(clients) == len(client_seqs)
+                == len(ref_seqs) == n):
+            raise ValueError("batch fields must have equal length")
+        for op in ops:
+            if not self._valid_op(op):
+                raise ValueError(f"malformed tree op {op!r}")
+        if self._graduated and any(d in self._graduated for d in doc_ids):
+            raise ValueError("a targeted doc has graduated off the flat "
+                             "tier; route its ops through submit()")
+        self.flush()  # per-op queue first: per-doc seq order must hold
+        rows = np.fromiter((self.doc_row(d) for d in doc_ids), np.int32,
+                           count=n)
+        self._fill_row_handles(np.unique(rows), raw)
+        t0 = time.perf_counter()
+        handles = self._row_handle[rows]
+        client = np.ascontiguousarray(clients, np.int32)
+        cseq = np.ascontiguousarray(client_seqs, np.int32)
+        ref = np.ascontiguousarray(ref_seqs, np.int32)
+        out_seq, out_min = raw.sequence_batch_rows(handles, client, cseq,
+                                                   ref)
+        self._poisoned = "tree batch failed after sequencing"
+        nacked = out_seq < 0
+        n_ok = int((~nacked).sum())
+        self.metrics.inc("ops_ingested", n_ok)
+        if nacked.any():
+            self.metrics.inc("nacks", int(nacked.sum()))
+
+        ok = np.flatnonzero(~nacked)
+        ts = self.deli.clock()
+        msgs = [SequencedDocumentMessage(
+            doc_id=doc_ids[i], client_id=int(client[i]),
+            client_seq=int(cseq[i]),
+            ref_seq=min(int(ref[i]), max(int(out_seq[i]) - 1, 0)),
+            seq=int(out_seq[i]), min_seq=int(out_min[i]),
+            type=MessageType.OP, contents=ops[i], timestamp=ts)
+            for i in ok]
+        # device apply dispatched before the log append (host log work
+        # rides under it), exactly the string pipeline's ordering
+        for m in msgs:
+            self._enqueue(m.doc_id, m)
+            self._min_seq[m.doc_id] = m.min_seq
+        self.flush()
+
+        # ONE whole-batch record: the op dicts ride the values table
+        id_tab = sorted(set(doc_ids))
+        id_of = {d: i for i, d in enumerate(id_tab)}
+        p = self._col_part
+        self._col_part = (p + 1) % self.log.n_partitions
+        ref_clamped = np.minimum(ref.astype(np.int64),
+                                 np.maximum(out_seq - 1, 0))
+        self.log.append(int(p), ColumnarOps(
+            id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
+                                count=len(ok)),
+            client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
+            out_min[ok], np.zeros(len(ok), np.int32),
+            np.arange(len(ok), dtype=np.int32),  # a0 → values table
+            np.zeros(len(ok), np.int32),
+            text="", timestamp=ts, family="ops",
+            values=[ops[i] for i in ok],
+            keys=None))
+        self._poisoned = None
+        self.metrics.inc("flushes")
+        self.metrics.inc("ops_flushed", n_ok)
+        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        return {"seq": out_seq, "nacked": int(nacked.sum())}
 
     def _store_of(self, doc_id: str):
         """(store, row) owning this doc, post-flush."""
@@ -1614,12 +2119,18 @@ class TreeServingEngine(ServingEngineBase):
         return out
 
     def _doc_log_messages(self, doc_id: str):
-        """Every sequenced OP message for one doc, seq-ascending (a doc
-        lives entirely in one partition — see string engine)."""
-        p = partition_of(doc_id, self.log.n_partitions)
-        msgs = [rec for rec in self.log.read(p)
-                if not isinstance(rec, ColumnarOps)
-                and rec.doc_id == doc_id and rec.type == MessageType.OP]
+        """Every sequenced OP message for one doc, seq-ascending. Per-op
+        records live in the doc's partition; whole-batch tree records
+        round-robin across partitions (see the string engine)."""
+        p_own = partition_of(doc_id, self.log.n_partitions)
+        msgs = []
+        for p in range(self.log.n_partitions):
+            for rec in self.log.read(p):
+                if isinstance(rec, ColumnarOps):
+                    msgs.extend(rec.expand(only_doc=doc_id))
+                elif p == p_own and rec.doc_id == doc_id \
+                        and rec.type == MessageType.OP:
+                    msgs.append(rec)
         msgs.sort(key=lambda m: m.seq)
         return msgs
 

@@ -21,3 +21,20 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# The full suite compiles many hundreds of distinct XLA programs; past a
+# threshold the in-process CPU compiler segfaults (observed twice at
+# different tests, always inside backend_compile_and_load). Bound the
+# live-executable arena by clearing jit caches between test modules, and
+# make the recompiles cheap with the persistent on-disk cache.
+jax.config.update("jax_compilation_cache_dir",
+                  "/tmp/fluidframework_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compile_arena():
+    yield
+    jax.clear_caches()

@@ -1,0 +1,165 @@
+"""Columnar map ingest (MapServingEngine.ingest_planes): parity with the
+per-op submit path, nack handling, and durable-log recovery of the
+family="map" whole-batch records."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops.schema import OpKind
+from fluidframework_tpu.server import native_deli
+from fluidframework_tpu.server.serving import MapServingEngine
+
+pytestmark = pytest.mark.skipif(not native_deli.available(),
+                                reason="native sequencer unavailable")
+
+SET, DEL, CLR = (int(OpKind.MAP_SET), int(OpKind.MAP_DELETE),
+                 int(OpKind.MAP_CLEAR))
+
+
+def _engines(R=16, O=12):
+    a = MapServingEngine(n_docs=R, batch_window=10 ** 9, sequencer="native")
+    b = MapServingEngine(n_docs=R, batch_window=10 ** 9)
+    docs = [f"m-{i}" for i in range(R)]
+    for e in (a, b):
+        for d in docs:
+            e.connect(d, 1)
+            e.doc_row(d)
+    rows = np.array([a.doc_row(d) for d in docs], np.int32)
+    return a, b, docs, rows
+
+
+def _batch(R, O, bi):
+    rng = np.random.default_rng(500 + bi)
+    keys = [f"k{j}" for j in range(6)]
+    values = [f"v{bi}-{j}" for j in range(5)] + [{"n": bi}, [1, bi], None]
+    kind = rng.choice([SET, SET, SET, DEL, CLR],
+                      p=[0.5, 0.2, 0.15, 0.1, 0.05], size=(R, O)) \
+        .astype(np.int32)
+    kidx = rng.integers(0, len(keys), size=(R, O)).astype(np.int32)
+    vidx = rng.integers(0, len(values), size=(R, O)).astype(np.int32)
+    return kind, kidx, keys, vidx, values
+
+
+def _submit_mirror(b, docs, kind, kidx, keys, vidx, values, cseq):
+    for d in range(kind.shape[0]):
+        for o in range(kind.shape[1]):
+            k = kind[d, o]
+            if k == CLR:
+                c = {"op": "clear"}
+            elif k == DEL:
+                c = {"op": "delete", "key": keys[kidx[d, o]]}
+            else:
+                c = {"op": "set", "key": keys[kidx[d, o]],
+                     "value": values[vidx[d, o]]}
+            _, nack = b.submit(docs[d], 1, int(cseq[d, o]), 0, c)
+            assert nack is None
+
+
+def test_map_columnar_matches_per_op_engine():
+    R, O = 16, 12
+    a, b, docs, rows = _engines(R, O)
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    for bi in range(3):
+        kind, kidx, keys, vidx, values = _batch(R, O, bi)
+        cseq = np.broadcast_to(
+            np.arange(bi * O + 1, (bi + 1) * O + 1, dtype=np.int32), (R, O))
+        res = a.ingest_planes(rows, client, cseq, ref, kind, kidx, keys,
+                              values, vidx)
+        assert res["nacked"] == 0
+        _submit_mirror(b, docs, kind, kidx, keys, vidx, values, cseq)
+    for d in docs:
+        assert a.read_doc(d) == b.read_doc(d), d
+
+
+def test_map_columnar_nacks_skipped():
+    R, O = 4, 8
+    a, _, docs, rows = _engines(R, O)
+    kind, kidx, keys, vidx, values = _batch(R, O, 0)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32),
+                           (R, O)).copy()
+    cseq[1, 3] = 99  # gap: ops 3.. of doc 1 nack
+    res = a.ingest_planes(rows, np.ones((R, O), np.int32), cseq,
+                          np.zeros((R, O), np.int32), kind, kidx, keys,
+                          values, vidx)
+    assert res["nacked"] == O - 3
+    assert (res["seq"][1, 3:] < 0).all()
+    # the logged record skips them
+    from fluidframework_tpu.server.serving import ColumnarOps
+    logged = sum(len(rec.seq) for p in range(a.log.n_partitions)
+                 for rec in a.log.read(p) if isinstance(rec, ColumnarOps))
+    assert logged == R * O - (O - 3)
+
+
+def test_map_columnar_recovery_through_log_replay():
+    R, O = 8, 10
+    a, b, docs, rows = _engines(R, O)
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    kind, kidx, keys, vidx, values = _batch(R, O, 0)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32), (R, O))
+    a.ingest_planes(rows, client, cseq, ref, kind, kidx, keys, values, vidx)
+    summary = a.summarize()
+    kind, kidx, keys, vidx, values = _batch(R, O, 1)
+    cseq = cseq + O
+    a.ingest_planes(rows, client, cseq, ref, kind, kidx, keys, values, vidx)
+    want = {d: a.read_doc(d) for d in docs}
+    revived = MapServingEngine.load(summary, a.log)
+    assert {d: revived.read_doc(d) for d in docs} == want
+    # sequencing resumes
+    _, nack = revived.submit(docs[0], 1, 2 * O + 1, 0,
+                             {"op": "set", "key": "fresh", "value": 1})
+    assert nack is None
+    assert revived.get(docs[0], "fresh") == 1
+
+
+def test_map_columnar_native_log_crash_recovery(tmp_path):
+    from fluidframework_tpu.server.native_oplog import (
+        NativePartitionedLog, available as oplog_available)
+    if not oplog_available():
+        pytest.skip("native oplog not built")
+    R, O = 6, 8
+    log = NativePartitionedLog(str(tmp_path), 4)
+    a = MapServingEngine(n_docs=R, batch_window=10 ** 9,
+                         sequencer="native", log=log, n_partitions=4)
+    docs = [f"m-{i}" for i in range(R)]
+    for d in docs:
+        a.connect(d, 1)
+        a.doc_row(d)
+    rows = np.array([a.doc_row(d) for d in docs], np.int32)
+    summary = a.summarize()
+    kind, kidx, keys, vidx, values = _batch(R, O, 2)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32), (R, O))
+    a.ingest_planes(rows, np.ones((R, O), np.int32), cseq,
+                    np.zeros((R, O), np.int32), kind, kidx, keys,
+                    values, vidx)
+    want = {d: a.read_doc(d) for d in docs}
+    log.sync()
+    log.close()  # the crash
+    revived = MapServingEngine.load(
+        summary, NativePartitionedLog(str(tmp_path), 4))
+    assert {d: revived.read_doc(d) for d in docs} == want
+
+
+def test_map_columnar_validation():
+    R, O = 2, 4
+    a, _, docs, rows = _engines(R, O)
+    client = np.ones((R, O), np.int32)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32), (R, O))
+    z = np.zeros((R, O), np.int32)
+    keys = ["k"]
+    seq_before = {d: a.deli.doc_seq(d) for d in docs}
+    bad = z.copy()
+    bad[0, 0] = 5
+    with pytest.raises(ValueError, match="keys table"):
+        a.ingest_planes(rows, client, cseq, z,
+                        np.full((R, O), SET, np.int32), bad, keys,
+                        ["v"], z)
+    with pytest.raises(ValueError, match="values table"):
+        a.ingest_planes(rows, client, cseq, z,
+                        np.full((R, O), SET, np.int32), z, keys,
+                        ["v"], bad)
+    with pytest.raises(ValueError, match="set/delete/clear"):
+        a.ingest_planes(rows, client, cseq, z, z, z, keys, ["v"], z)
+    for d in docs:  # nothing sequenced by rejected batches
+        assert a.deli.doc_seq(d) == seq_before[d]

@@ -70,5 +70,24 @@ def test_graft_entry_and_dryrun():
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
     jax.block_until_ready(out)
-    g.dryrun_multichip(8)
-    g.dryrun_multichip(4)
+    # the dryrun runs in its own PROCESS, exactly as the driver invokes
+    # it (the engine drill is heavyweight; in-process it shares this
+    # long-lived suite interpreter's jit caches and native-lib state)
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ,
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8")
+               .strip())
+    for n in (8, 4):
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as g; "
+             f"g._ensure_virtual_devices({n}); g.dryrun_multichip({n})"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            env=env, capture_output=True, text=True,
+            timeout=900)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert "dryrun_multichip OK" in proc.stdout

@@ -1,0 +1,118 @@
+"""Batched tree ingest (TreeServingEngine.ingest_batch): parity with the
+per-op submit path, nacks, and recovery of family="tree" batch records."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.server import native_deli
+from fluidframework_tpu.server.serving import TreeServingEngine
+
+pytestmark = pytest.mark.skipif(not native_deli.available(),
+                                reason="native sequencer unavailable")
+
+
+def _ops_wave(docs, wave):
+    """One edit per doc: insert a node under root, then set its value."""
+    doc_ids, ops = [], []
+    for d in docs:
+        doc_ids.append(d)
+        if wave == 0:
+            ops.append({"op": "insert", "parent": "root", "field": "kids",
+                        "after": None,
+                        "nodes": [{"id": f"{d}-n0", "type": "item",
+                                   "value": 0}]})
+        else:
+            prev = f"{d}-n{wave - 1}"
+            ops.append({"op": "transaction",
+                        "constraints": [{"nodeExists": prev}],
+                        "edits": [
+                            {"op": "insert", "parent": "root",
+                             "field": "kids", "after": prev,
+                             "nodes": [{"id": f"{d}-n{wave}",
+                                        "type": "item", "value": wave}]},
+                            {"op": "setValue", "id": prev,
+                             "value": wave * 10}]})
+    return doc_ids, ops
+
+
+def _mk(R=16):
+    eng = TreeServingEngine(n_docs=R, capacity=128, batch_window=10 ** 9,
+                            sequencer="native")
+    ora = TreeServingEngine(n_docs=R, capacity=128, batch_window=10 ** 9)
+    docs = [f"t-{i}" for i in range(R)]
+    for e in (eng, ora):
+        for d in docs:
+            e.connect(d, 1)
+    return eng, ora, docs
+
+
+def test_tree_batch_matches_per_op_engine():
+    eng, ora, docs = _mk()
+    for wave in range(4):
+        doc_ids, ops = _ops_wave(docs, wave)
+        res = eng.ingest_batch(doc_ids, [1] * len(ops),
+                               [wave + 1] * len(ops), [0] * len(ops), ops)
+        assert res["nacked"] == 0
+        for d, op in zip(doc_ids, ops):
+            _, nack = ora.submit(d, 1, wave + 1, 0, op)
+            assert nack is None
+    for d in docs:
+        assert eng.to_dict(d) == ora.to_dict(d), d
+    assert np.array_equal(eng.store.digests(), ora.store.digests())
+
+
+def test_tree_batch_nack_skipped():
+    eng, _, docs = _mk(R=4)
+    doc_ids, ops = _ops_wave(docs, 0)
+    cseqs = [1, 99, 1, 1]  # doc 1's clientSeq gap nacks
+    res = eng.ingest_batch(doc_ids, [1] * 4, cseqs, [0] * 4, ops)
+    assert res["nacked"] == 1
+    assert res["seq"][1] < 0
+    assert not eng.has_node(docs[1], f"{docs[1]}-n0")
+    assert eng.has_node(docs[0], f"{docs[0]}-n0")
+
+
+def test_tree_batch_recovery_through_log_replay():
+    eng, _, docs = _mk(R=8)
+    doc_ids, ops = _ops_wave(docs, 0)
+    eng.ingest_batch(doc_ids, [1] * len(ops), [1] * len(ops),
+                     [0] * len(ops), ops)
+    summary = eng.summarize()
+    for wave in (1, 2):
+        doc_ids, ops = _ops_wave(docs, wave)
+        assert eng.ingest_batch(doc_ids, [1] * len(ops),
+                                [wave + 1] * len(ops), [0] * len(ops),
+                                ops)["nacked"] == 0
+    want = {d: eng.to_dict(d) for d in docs}
+    revived = TreeServingEngine.load(summary, eng.log)
+    assert {d: revived.to_dict(d) for d in docs} == want
+    _, nack = revived.submit(docs[0], 1, 4, 0,
+                             {"op": "setValue", "id": f"{docs[0]}-n0",
+                              "value": "tail"})
+    assert nack is None
+    assert revived.node_value(docs[0], f"{docs[0]}-n0") == "tail"
+
+
+def test_tree_batch_overflow_recovery_expands_columnar():
+    """A doc rebuilt from the log must replay ops logged as whole-batch
+    tree records (the rebuild path expands family='tree')."""
+    eng, _, docs = _mk(R=4)
+    d = docs[0]
+    # many sibling inserts via batches until the doc overflows cap 128
+    cseq = 1
+    for wave in range(3):
+        ids = [d] * 50
+        ops = []
+        for k in range(50):
+            ops.append({"op": "insert", "parent": "root", "field": "kids",
+                        "after": None,
+                        "nodes": [{"id": f"{d}-w{wave}-{k}",
+                                   "type": "x", "value": k}]})
+        res = eng.ingest_batch(ids, [1] * 50,
+                               list(range(cseq, cseq + 50)), [0] * 50, ops)
+        assert res["nacked"] == 0
+        cseq += 50
+    assert eng.store.overflowed()[eng.doc_row(d)]
+    report = eng.recover_overflowed()
+    assert report.get(d) == "graduated", report
+    assert eng.node_count(d) == 151  # root + 150 inserts, none lost

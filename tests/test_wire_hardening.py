@@ -1,0 +1,166 @@
+"""Wire-tier hardening (VERDICT r3 weak #8): bounded outbound queues with
+slow-client eviction, and a flaky-socket fault injector exercising
+reconnect-with-pending-rebase over a real TCP link."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.framework.fluid_static import NetworkClient
+from fluidframework_tpu.server import wire
+from fluidframework_tpu.server.ingress import AlfredServer
+
+SCHEMA = {"initialObjects": {"text": "sharedString"}}
+
+
+# ------------------------------------------------- slow-client eviction
+
+
+def test_slow_client_is_evicted_not_buffered():
+    """A client that never drains its broadcast stream must be EVICTED
+    when its bounded outbound queue fills; healthy clients keep going."""
+    srv = AlfredServer(port=0, max_outbound=8).start_in_thread()
+    try:
+        # slow client: subscribes, never reads
+        slow = socket.create_connection(("127.0.0.1", srv.port))
+        wire.send_frame(slow, {"t": "connect", "doc": "dd"})
+        _ = wire.recv_frame(slow)  # connected ack
+        # healthy client floods the doc
+        good = socket.create_connection(("127.0.0.1", srv.port))
+        wire.send_frame(good, {"t": "connect", "doc": "dd"})
+        _ = wire.recv_frame(good)
+        got = 0
+        blob = "x" * 65536    # large frames: kernel buffers fill, the
+        for i in range(128):  # stalled reader's queue hits its bound
+            wire.send_frame(good, {"t": "op", "client_seq": i + 1,
+                                   "contents": {"i": i, "b": blob},
+                                   "ref_seq": 0})
+            # a healthy client DRAINS its stream as it goes
+            while True:
+                frame = wire.recv_frame(good)
+                if frame.get("t") == "op":
+                    got += 1
+                    break
+        assert got == 128         # the healthy client saw everything
+        deadline = time.monotonic() + 10
+        while srv.evictions < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert srv.evictions == 1  # the slow one was disconnected
+        good.close()
+        slow.close()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------ flaky-socket injection
+
+
+class _FlakyProxy:
+    """TCP proxy that hard-closes the live connection when armed — the
+    network failing mid-session, not a graceful disconnect."""
+
+    def __init__(self, upstream_port: int):
+        self.upstream_port = upstream_port
+        self._srv = socket.socket()
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._cut = threading.Event()
+        self._live = []
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def cut(self):
+        """Kill every live proxied connection NOW."""
+        self._cut.set()
+        for s in list(self._live):
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._live.clear()
+        self._cut.clear()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                client, _ = self._srv.accept()
+            except OSError:
+                return
+            up = socket.create_connection(("127.0.0.1",
+                                           self.upstream_port))
+            self._live += [client, up]
+            threading.Thread(target=self._pump, args=(client, up),
+                             daemon=True).start()
+            threading.Thread(target=self._pump, args=(up, client),
+                             daemon=True).start()
+
+    def _pump(self, src, dst):
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self._srv.close()
+
+
+def test_flaky_socket_reconnect_with_pending_rebase():
+    """The link dies AFTER a local edit is submitted but before its ack
+    arrives; on reconnect the pending op must rebase/resubmit and the
+    document converge — no loss, no duplication (VERDICT r3 weak #8)."""
+    srv = AlfredServer(port=0).start_in_thread()
+    proxy = _FlakyProxy(srv.port)
+    try:
+        # creator goes DIRECT (stable); the flaky client rides the proxy
+        direct = NetworkClient(port=srv.port, enable_summarizer=False)
+        fc0, doc_id = direct.create_container(SCHEMA, doc_id="flaky-doc")
+        text0 = fc0.initial_objects["text"]
+        text0.insert_text(0, "base;")
+        fc0.flush()
+        fc0.pump_until(lambda: text0.get_text() == "base;")
+
+        flaky = NetworkClient(port=proxy.port, enable_summarizer=False)
+        fc1 = flaky.get_container(doc_id, SCHEMA)
+        text1 = fc1.initial_objects["text"]
+        fc1.pump_until(lambda: text1.get_text() == "base;")
+
+        # a local edit goes out... and the network dies before the ack
+        text1.insert_text(0, "PENDING;")
+        fc1.flush()
+        proxy.cut()
+        time.sleep(0.3)
+
+        # reconnect over a fresh (healthy) proxied connection: the pending
+        # op must be resubmitted/rebased by the connection machinery
+        fc1.disconnect("link died")
+        fc1.connect()
+        fc1.pump_until(lambda: "PENDING;" in text1.get_text(), timeout=20)
+        fc0.pump_until(lambda: "PENDING;" in text0.get_text(), timeout=20)
+        assert text0.get_text() == text1.get_text()
+        assert text0.get_text().count("PENDING;") == 1  # no duplication
+
+        # the revived session still serves new edits both ways
+        text1.insert_text(0, "after;")
+        fc1.flush()
+        fc0.pump_until(lambda: text0.get_text().startswith("after;"),
+                       timeout=20)
+        fc0.dispose()
+        fc1.dispose()
+    finally:
+        proxy.close()
+        srv.stop()
