@@ -111,6 +111,24 @@ apply_axis_batch_jit = jax.jit(apply_axis_batch, donate_argnums=0)
 
 
 @jax.jit
+def resolve_axis_positions(state: StringState, pos, client, ref_seq):
+    """Resolve a (D, O) batch of positions against the CURRENT axis state
+    — no interleaved mutations, so every resolve sees the same planes and
+    the whole batch is a pure vmap (elementwise, no sequential scan): the
+    fast path for resolve-only windows (columnar setCell ingest, reads).
+    Returns (run, off) (D, O) planes, -1 where out of range."""
+    sd = {k: getattr(state, k) for k in _PLANES} | {
+        "count": state.count, "overflow": state.overflow}
+
+    def per_doc(s, p_row, cl_row, rs_row):
+        return jax.vmap(lambda p, c, r: _resolve_one(s, p, c, r))(
+            p_row, cl_row, rs_row)
+
+    rh, ro = jax.vmap(per_doc)(sd, pos, client, ref_seq)
+    return rh, ro
+
+
+@jax.jit
 def axis_visible_lengths(state: StringState):
     """(D,) latest-view visible length per axis row (dims read)."""
     S = state.seq.shape[1]
@@ -155,7 +173,19 @@ class TensorAxisStore:
 
     def apply(self, planes: dict) -> Tuple[np.ndarray, np.ndarray]:
         """One device dispatch; returns host (D2, O) resolve outputs
-        (the flush's single device→host read)."""
+        (the flush's single device→host read). A resolve-only window
+        skips the sequential scan entirely (pure vmap — see
+        ``resolve_axis_positions``)."""
+        kind = np.asarray(planes["kind"])
+        if np.isin(kind, (int(OpKind.AXIS_RESOLVE),
+                          int(OpKind.NOOP))).all():
+            rh, ro = resolve_axis_positions(
+                self.state, jnp.asarray(planes["a0"]),
+                jnp.asarray(planes["client"]),
+                jnp.asarray(planes["ref_seq"]))
+            is_res = kind == int(OpKind.AXIS_RESOLVE)
+            return (np.where(is_res, np.asarray(rh), -1),
+                    np.where(is_res, np.asarray(ro), -1))
         self.state, rh, ro = apply_axis_batch_jit(
             self.state,
             *(jnp.asarray(planes[k]) for k in

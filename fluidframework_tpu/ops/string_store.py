@@ -1029,27 +1029,30 @@ class TensorStringStore(StringOpInterner):
 
     # ------------------------------------------------- overflow recovery
 
-    def adopt_doc(self, row: int, tmp: "TensorStringStore") -> None:
-        """Adopt ``tmp``'s single-doc rebuilt state into ``row`` — the
-        re-upload step of the overflow escape hatch (SURVEY.md §7 risk (b)):
-        payload handles re-intern into this store's table, the per-doc
-        client map transfers wholesale (client indexes are doc-local, so
-        client/removers planes carry over bit-exact), property planes remap
-        by key, and the row's device planes are overwritten in one jitted
-        update that also clears the sticky overflow flag. ``tmp`` must fit:
-        count ≤ capacity and no overflow."""
-        n = int(np.asarray(tmp.state.count[0]))
-        assert n <= self.capacity and not tmp.overflowed().any()
-        planes = {k: np.asarray(getattr(tmp.state, k)[0][:n]).copy()
+    def adopt_doc(self, row: int, tmp: "TensorStringStore",
+                  src_row: int = 0) -> None:
+        """Adopt row ``src_row`` of ``tmp``'s rebuilt state into ``row`` —
+        the re-upload step of the overflow escape hatch (SURVEY.md §7
+        risk (b)): payload handles re-intern into this store's table, the
+        per-doc client map transfers wholesale (client indexes are
+        doc-local, so client/removers planes carry over bit-exact),
+        property planes remap by key, and the row's device planes are
+        overwritten in one jitted update that also clears the sticky
+        overflow flag. The source row must fit: count ≤ capacity and no
+        overflow."""
+        n = int(np.asarray(tmp.state.count[src_row]))
+        assert n <= self.capacity and not tmp.overflowed()[src_row]
+        planes = {k: np.asarray(getattr(tmp.state, k)[src_row][:n]).copy()
                   for k in _PLANES}
         planes["handle_op"] = self.remap_payload_handles(
             tmp, planes["handle_op"])
-        self._client_idx[row] = dict(tmp._client_idx[0])
+        self._client_idx[row] = dict(tmp._client_idx[src_row])
 
         prop = np.zeros((self.capacity, self.n_props), np.int32)
         if tmp._has_props:
             self._has_props = True
-            self.remap_props(tmp, np.asarray(tmp.state.prop_val[0][:n]),
+            self.remap_props(tmp,
+                             np.asarray(tmp.state.prop_val[src_row][:n]),
                              prop)
 
         def pad(a, fill=0):

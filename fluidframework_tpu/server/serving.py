@@ -266,7 +266,7 @@ class ServingEngineBase:
             return self._nacked(Nack(doc_id, client_id, client_seq,
                                      NackReason.MALFORMED))
         try:
-            self._admit(doc_id, contents)
+            self._admit(doc_id, contents, client_id)
         except KeyError:
             return self._nacked(Nack(doc_id, client_id, client_seq,
                                      NackReason.CAPACITY))
@@ -297,10 +297,12 @@ class ServingEngineBase:
     def _is_nat(v, lo: int = 0) -> bool:
         return isinstance(v, int) and not isinstance(v, bool) and v >= lo
 
-    def _admit(self, doc_id: str, contents: Any) -> None:
+    def _admit(self, doc_id: str, contents: Any,
+               client_id: int = -1) -> None:
         """Reserve the capacity the op will need at flush (doc row here;
-        subclasses add store-specific reservations like key slots). Raises
-        KeyError on exhaustion → the op is nacked before it is logged."""
+        subclasses add store-specific reservations like key/client
+        slots). Raises KeyError on exhaustion → the op is nacked before
+        it is logged."""
         self.doc_row(doc_id)
 
     def _unadmit(self, doc_id: str, contents: Any) -> None:
@@ -546,7 +548,8 @@ class StringServingEngine(ServingEngineBase):
                                           required=True))
         return False
 
-    def _admit(self, doc_id: str, contents: Any) -> None:
+    def _admit(self, doc_id: str, contents: Any,
+               client_id: int = -1) -> None:
         """Row + property-interner reservation (KeyError → CAPACITY nack
         before the op is logged): an annotate whose key cannot get a plane
         would otherwise raise at flush. The reservation is transactional —
@@ -934,8 +937,14 @@ class StringServingEngine(ServingEngineBase):
         # rebuild replays the FULL log, so the queues must be empty
         report: Dict[str, str] = {}
         flags = self.store.overflowed()
-        for doc_id in [d for d, r in self._doc_rows.items() if flags[r]]:
-            report[doc_id] = self._recover_flat(doc_id, grow_limit)
+        flat = [d for d, r in self._doc_rows.items() if flags[r]]
+        if flat:
+            # BATCHED rebuild: a correlated mass overflow (identical
+            # workloads hitting capacity together) rebuilds every doc in
+            # ONE multi-doc temp store per capacity doubling — 2 device
+            # reads per doubling instead of 2 per doc (each is a full
+            # tunnel round-trip)
+            report.update(self._recover_flat_batch(flat, grow_limit))
         if self.mega_store is not None and self._mega_rows:
             mflags = self.mega_store.overflowed()
             for doc_id in [d for d, r in self._mega_rows.items()
@@ -994,26 +1003,80 @@ class StringServingEngine(ServingEngineBase):
         tmp.compact(self._min_seq.get(doc_id, 0))
         return tmp
 
-    def _recover_flat(self, doc_id: str, grow_limit: int) -> str:
-        row = self._doc_rows[doc_id]
-        tmp = self._rebuild_doc(doc_id, self.store.capacity, grow_limit)
-        # intervals: anchors reference pre-rebuild payload handles; re-derive
-        # them at the same visible positions in the rebuilt text (the best
-        # information an overflowed row can offer)
-        ivs = self.store.intervals(row) if self.store._intervals[row] else {}
-        if int(np.asarray(tmp.state.count[0])) <= self.store.capacity:
-            self.store.adopt_doc(row, tmp)
-            self._readd_intervals(self.store, row, ivs)
-            # planes changed without the doc sequencing anything: the next
-            # incremental summary must ship this row
-            self._dirty_outside_ops.add(doc_id)
-            return "reuploaded"
-        self.store._intervals[row] = {}
-        self.store.clear_doc(row)
-        self._graduated[doc_id] = tmp
-        self._readd_intervals(tmp, 0, ivs)
-        self._release_flat_row(doc_id)
-        return "graduated"
+    def _docs_log_messages(self, doc_ids: List[str]
+                           ) -> Dict[str, list]:
+        """Per-doc seq-ascending OP messages for MANY docs in ONE pass
+        over the durable log (per-doc scans would decode every columnar
+        record K times in the mass-overflow case)."""
+        want = set(doc_ids)
+        buckets: Dict[str, list] = {d: [] for d in doc_ids}
+        for p in range(self.log.n_partitions):
+            for rec in self.log.read(p):
+                if isinstance(rec, ColumnarOps):
+                    hits = want.intersection(rec.doc_ids)
+                    if not hits:
+                        continue
+                    if len(hits) == 1:
+                        d = next(iter(hits))
+                        buckets[d].extend(rec.expand(only_doc=d))
+                    else:
+                        for m in rec.expand():
+                            if m.doc_id in want:
+                                buckets[m.doc_id].append(m)
+                elif rec.doc_id in want and rec.type == MessageType.OP:
+                    buckets[rec.doc_id].append(rec)
+        for d in buckets:
+            buckets[d].sort(key=lambda m: m.seq)
+        return buckets
+
+    def _recover_flat_batch(self, doc_ids: List[str],
+                            grow_limit: int) -> Dict[str, str]:
+        """Rebuild every overflowed flat-tier doc together: one K-doc temp
+        store per capacity doubling, one batched apply, one compact, two
+        device reads. Docs that fit re-upload into their rows; docs still
+        too big graduate to their own right-sized stores."""
+        report: Dict[str, str] = {}
+        msgs = self._docs_log_messages(doc_ids)
+        pending = list(doc_ids)
+        cap = max(self.store.capacity, 128)
+        while pending:
+            cap *= 2
+            if cap > grow_limit:
+                raise MemoryError(
+                    f"{pending[0]}: rebuild exceeds grow limit "
+                    f"{grow_limit}")
+            tmp = TensorStringStore(len(pending), cap, self.store.n_props)
+            tmp.apply_messages([(i, m) for i, d in enumerate(pending)
+                                for m in msgs[d]])
+            tmp.compact(np.fromiter(
+                (self._min_seq.get(d, 0) for d in pending), np.int32,
+                count=len(pending)))
+            ov = tmp.overflowed()
+            counts = np.asarray(tmp.state.count)
+            nxt = []
+            for i, d in enumerate(pending):
+                if ov[i]:
+                    nxt.append(d)  # even doubled didn't fit: grow again
+                    continue
+                row = self._doc_rows[d]
+                ivs = self.store.intervals(row) \
+                    if self.store._intervals[row] else {}
+                if int(counts[i]) <= self.store.capacity:
+                    self.store.adopt_doc(row, tmp, src_row=i)
+                    self._readd_intervals(self.store, row, ivs)
+                    self._dirty_outside_ops.add(d)
+                    report[d] = "reuploaded"
+                else:
+                    single = TensorStringStore(1, cap, self.store.n_props)
+                    single.adopt_doc(0, tmp, src_row=i)
+                    self.store._intervals[row] = {}
+                    self.store.clear_doc(row)
+                    self._graduated[d] = single
+                    self._readd_intervals(single, 0, ivs)
+                    self._release_flat_row(d)
+                    report[d] = "graduated"
+            pending = nxt
+        return report
 
     def _release_flat_row(self, doc_id: str) -> None:
         """Return a graduated doc's flat row to the allocator (and clear
@@ -1360,7 +1423,8 @@ class MapServingEngine(ServingEngineBase):
                 return False
         return True
 
-    def _admit(self, doc_id: str, contents: Any) -> None:
+    def _admit(self, doc_id: str, contents: Any,
+               client_id: int = -1) -> None:
         row = self.doc_row(doc_id)
         if contents["op"] != "clear":
             self.store.key_slot(row, contents["key"])  # reserve (KeyError
@@ -1499,14 +1563,20 @@ class MatrixServingEngine(ServingEngineBase):
                 return False
         return True  # policy
 
-    def _admit(self, doc_id: str, contents: Any) -> None:
+    def _admit(self, doc_id: str, contents: Any,
+               client_id: int = -1) -> None:
         super()._admit(doc_id, contents)
+        row = self.doc_row(doc_id)
+        if client_id >= 0 and contents["mx"] != "policy":
+            # per-axis client capacity (MAX_CLIENTS): mint now so an op
+            # that cannot be applied is CAPACITY-nacked, never acked
+            self.axis_store.client(2 * row, client_id)
+            self.axis_store.client(2 * row + 1, client_id)
         if contents["mx"] in ("insRow", "insCol", "rmRow", "rmCol"):
             # device axis rows are fixed-capacity: an acked axis op the
             # kernel must drop (sticky overflow) would silently corrupt
             # dims/cells — nack at admission when the conservative bound
             # says the axis may not fit it
-            row = self.doc_row(doc_id)
             axis = 2 * row + (1 if contents["mx"].endswith("Col") else 0)
             if self._axis_used[axis] + 2 > self.axis_store.capacity:
                 raise KeyError("axis slot capacity exhausted")
@@ -1741,26 +1811,21 @@ class MatrixServingEngine(ServingEngineBase):
         return {"seq": out_seq, "nacked": int(nacked.sum())}
 
     def _dispatch_axis(self, per_axis: Dict[int, list]):
-        """Dense (2·D, O) planes from per-axis op lists → one scan."""
+        """Dense (2·D, O) planes from per-axis op lists → one scan.
+        Vectorized packing: one ``np.array`` per axis's record list + one
+        slice write per plane, not a per-element Python triple loop."""
         widest = max(len(v) for v in per_axis.values())
         o = 8
         while o < widest:
             o *= 2
         D2 = 2 * self.n_docs
-        planes = {
-            "kind": np.full((D2, o), int(OpKind.NOOP), np.int32),
-            "a0": np.zeros((D2, o), np.int32),
-            "a1": np.zeros((D2, o), np.int32),
-            "a2": np.zeros((D2, o), np.int32),
-            "seq": np.zeros((D2, o), np.int32),
-            "client": np.zeros((D2, o), np.int32),
-            "ref_seq": np.zeros((D2, o), np.int32),
-        }
         names = ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")
+        stack = np.zeros((7, D2, o), np.int32)
+        stack[0] = int(OpKind.NOOP)
         for axis, recs in per_axis.items():
-            for j, rec in enumerate(recs):
-                for name, v in zip(names, rec):
-                    planes[name][axis, j] = v
+            arr = np.array(recs, np.int32)          # (k, 7)
+            stack[:, axis, :len(recs)] = arr.T
+        planes = {name: stack[i] for i, name in enumerate(names)}
         return self.axis_store.apply(planes)
 
     def overflowed(self) -> bool:
@@ -1854,6 +1919,10 @@ class MatrixServingEngine(ServingEngineBase):
         engine._cell_meta = {
             row: {tuple_key(cell): tuple(sw) for cell, sw in items}
             for row, items in summary["cell_meta"].items()}
+        # re-base the axis-slot admission bound from the restored planes
+        # (a zeroed bound would admit ops the full axis cannot hold)
+        engine._axis_used = np.asarray(axis.state.count,
+                                       dtype=np.int64).copy()
         engine._replay_tail(summary)
         engine.flush()
         return engine
@@ -1973,7 +2042,8 @@ class TreeServingEngine(ServingEngineBase):
         self._note_row(doc_id, row)
         return row
 
-    def _admit(self, doc_id: str, contents: Any) -> None:
+    def _admit(self, doc_id: str, contents: Any,
+               client_id: int = -1) -> None:
         if doc_id not in self._graduated:
             # graduated docs own their store; don't re-pin a tier row
             self.doc_row(doc_id)

@@ -652,3 +652,71 @@ def test_valid_op_rejects_boolean_kind():
     msg, nack = engine.submit(
         "d", 1, 1, 0, {"mt": "insert", "kind": True, "pos": 0})
     assert msg is None and nack.reason == NackReason.MALFORMED
+
+
+def test_matrix_33rd_client_is_capacity_nacked():
+    """Per-axis client capacity (MAX_CLIENTS=32): the 33rd distinct
+    client's op must be CAPACITY-nacked BEFORE sequencing — an acked op
+    the flush path cannot apply would diverge server reads from every
+    client replica (review r4 finding)."""
+    from fluidframework_tpu.ops.merge_tree_kernel import MAX_CLIENTS
+    from fluidframework_tpu.server.deli import NackReason
+    from fluidframework_tpu.server.serving import MatrixServingEngine
+    eng = MatrixServingEngine(n_docs=1, cell_capacity=4096,
+                              batch_window=10 ** 9, axis_capacity=64)
+    eng.connect("m", 1)
+    msg, nack = eng.submit("m", 1, 1, 0, {"mx": "insRow", "pos": 0,
+                                          "count": 4, "opKey": (1, 1)})
+    assert nack is None
+    seq = msg.seq
+    for c in range(2, MAX_CLIENTS + 1):  # clients 2..32 fit
+        eng.connect("m", c)
+        msg, nack = eng.submit("m", c, 1, seq,
+                               {"mx": "setCell", "row": 0, "col": 0,
+                                "value": c})
+        # col axis is empty: the op may drop at flush, but it must ACK
+        assert nack is None
+        seq = msg.seq
+    eng.connect("m", 999)
+    doc_seq_before = eng.deli.doc_seq("m")
+    _, nack = eng.submit("m", 999, 1, seq,
+                         {"mx": "setCell", "row": 0, "col": 0,
+                          "value": "x"})
+    assert nack is not None and nack.reason == NackReason.CAPACITY
+    assert eng.deli.doc_seq("m") == doc_seq_before  # nothing sequenced
+    eng.flush()  # engine still healthy
+
+
+def test_matrix_axis_admission_rebased_after_load():
+    """load() must re-base the axis-slot admission bound from the
+    restored planes — a zeroed bound would admit ops past capacity
+    (review r4 finding)."""
+    from fluidframework_tpu.server.serving import MatrixServingEngine
+    log = PartitionedLog(4)
+    eng = MatrixServingEngine(n_docs=1, cell_capacity=4096,
+                              batch_window=10 ** 9, axis_capacity=16,
+                              log=log)
+    eng.connect("m", 1)
+    cs = 0
+    for k in range(6):  # 6 admitted axis ops ≈ 12/16 of the bound
+        cs += 1
+        _, nack = eng.submit("m", 1, cs, 0,
+                             {"mx": "insRow", "pos": 0, "count": 1,
+                              "opKey": (1, cs)})
+        assert nack is None
+    revived = MatrixServingEngine.load(eng.summarize(), log,
+                                       axis_capacity=16)
+    assert revived._axis_used[0] >= 6  # bound reflects restored planes
+    # headroom accounting continues: (16-6)//2 = 5 more fit...
+    for k in range(5):
+        cs += 1
+        _, nack = revived.submit("m", 1, cs, 0,
+                                 {"mx": "insRow", "pos": 0, "count": 1,
+                                  "opKey": (1, cs)})
+        assert nack is None
+    # ...then the conservative bound trips before the axis can overflow
+    cs += 1
+    _, nack = revived.submit("m", 1, cs, 0,
+                             {"mx": "insRow", "pos": 0, "count": 1,
+                              "opKey": (1, cs)})
+    assert nack is not None
