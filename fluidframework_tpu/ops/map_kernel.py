@@ -101,6 +101,15 @@ def apply_map_batch(state: MapState, kind: jax.Array, a0: jax.Array,
 apply_map_batch_jit = jax.jit(apply_map_batch, donate_argnums=0)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("R", "O", "n_docs", "scatter_rows",
+                                    "wide_vals"))
+def map_columnar_unpack_jit(buf, R, O, n_docs, scatter_rows, wide_vals):
+    """Unpack half of ``map_columnar_apply_jit`` (used standalone when
+    the merge runs as a separate sharded program)."""
+    return _map_unpack(buf, R, O, n_docs, scatter_rows, wide_vals)
+
+
 @functools.partial(jax.jit, donate_argnums=0,
                    static_argnames=("R", "O", "n_docs", "scatter_rows",
                                     "wide_vals"))
@@ -114,6 +123,11 @@ def map_columnar_apply_jit(state, buf, R, O, n_docs, scatter_rows,
     ``_columnar_unpack_jit``). Per-op seqs rebuild on device from each
     row's base (nacked slots are NOOP and consumed no seq); map merge is
     the closed-form reduction of ``apply_map_batch``."""
+    return apply_map_batch(
+        state, *_map_unpack(buf, R, O, n_docs, scatter_rows, wide_vals))
+
+
+def _map_unpack(buf, R, O, n_docs, scatter_rows, wide_vals):
     N = R * O
 
     def take_u8(off, n):
@@ -152,7 +166,7 @@ def map_columnar_apply_jit(state, buf, R, O, n_docs, scatter_rows,
 
         planes = (full(kind, int(OpKind.NOOP)), full(a0, 0), full(a1, 0),
                   full(seq, 0))
-    return apply_map_batch(state, *planes)
+    return planes
 
 
 def map_state_digest(state: MapState) -> jax.Array:
@@ -173,10 +187,20 @@ class TensorMapStore:
     optimistic editing stays in ``models.SharedMap`` (host).
     """
 
-    def __init__(self, n_docs: int, n_keys: int = 64):
+    def __init__(self, n_docs: int, n_keys: int = 64, mesh=None):
         self.n_docs = n_docs
         self.n_keys = n_keys
+        # multi-chip: a 1-D "docs" mesh shards the planes by doc row; the
+        # map merge is a per-doc closed-form reduction, so the sharded
+        # apply is a collective-free shard_map of the same kernel
+        self.mesh = mesh
+        if mesh is not None and n_docs % mesh.devices.size != 0:
+            raise ValueError(f"n_docs {n_docs} not divisible by mesh size "
+                             f"{mesh.devices.size}")
         self.state = MapState.create(n_docs, n_keys)
+        if mesh is not None:
+            from ..parallel.sharded import shard_map_store_state
+            self.state = shard_map_store_state(self.state, mesh)
         self._key_ids: List[Dict[str, int]] = [dict() for _ in range(n_docs)]
         self._interner = ValueInterner()
 
@@ -254,14 +278,18 @@ class TensorMapStore:
         }
 
     @classmethod
-    def restore(cls, snap: dict) -> "TensorMapStore":
+    def restore(cls, snap: dict, mesh=None) -> "TensorMapStore":
         store = cls.__new__(cls)
         store.n_docs = snap["present"].shape[0]
         store.n_keys = snap["n_keys"]
+        store.mesh = mesh
         store.state = MapState(
             present=jnp.asarray(snap["present"]),
             value=jnp.asarray(snap["value"]),
             last_seq=jnp.asarray(snap["last_seq"]))
+        if mesh is not None:
+            from ..parallel.sharded import shard_map_store_state
+            store.state = shard_map_store_state(store.state, mesh)
         store._key_ids = [dict(m) for m in snap["key_ids"]]
         store._interner = ValueInterner.restore(snap["values"])
         return store

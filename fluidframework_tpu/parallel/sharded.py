@@ -127,6 +127,42 @@ def sharded_compact(mesh: Mesh, with_props: bool):
     return _CACHE[key]
 
 
+def map_state_specs():
+    """PartitionSpecs of every MapState plane on a docs-only mesh."""
+    from ..ops.map_kernel import MapState
+    row = P(DOC_AXIS, None)
+    return MapState(present=row, value=row, last_seq=row)
+
+
+def shard_map_store_state(state, mesh: Mesh):
+    """Place a map store's planes onto the mesh, doc-row sharded."""
+    if state.present.shape[0] % mesh.devices.size != 0:
+        raise ValueError(f"n_docs {state.present.shape[0]} not divisible "
+                         f"by mesh size {mesh.devices.size}")
+    return jax.tree.map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+        state, map_state_specs())
+
+
+def sharded_map_merge(mesh: Mesh):
+    """The doc-sharded columnar map apply (collective-free shard_map of
+    the per-doc LWW reduction); one program per mesh — jit specializes
+    on plane shapes."""
+    key = ("map_merge", mesh)
+    if key not in _CACHE:
+        from ..ops.map_kernel import apply_map_batch
+        specs = map_state_specs()
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def fn(state, planes):
+            return jax.shard_map(
+                apply_map_batch, mesh=mesh,
+                in_specs=(specs,) + (P(DOC_AXIS, None),) * 4,
+                out_specs=specs, check_vma=False)(state, *planes)
+        _CACHE[key] = fn
+    return _CACHE[key]
+
+
 def assert_collective_free(mesh: Mesh, n_docs: int, capacity: int,
                            n_ops: int) -> str:
     """Compile the sharded merge at the given shape and prove the apply

@@ -1238,11 +1238,18 @@ class MapServingEngine(ServingEngineBase):
                  batch_window: int = 64, n_partitions: int = 8,
                  log: Optional[PartitionedLog] = None,
                  store: Optional[TensorMapStore] = None,
-                 sequencer: str = "python"):
+                 sequencer: str = "python", mesh=None):
+        """``mesh``: a 1-D ``docs`` device mesh shards the map planes by
+        doc row; the columnar merge runs as a collective-free shard_map
+        (same scale-out shape as the string engine's)."""
         super().__init__(batch_window, n_partitions, log=log,
                          sequencer=sequencer)
+        if store is not None and mesh is not None \
+                and getattr(store, "mesh", None) is not mesh:
+            raise ValueError("mesh given with a store not sharded over it")
         self.store = store if store is not None \
-            else TensorMapStore(n_docs, n_keys)
+            else TensorMapStore(n_docs, n_keys, mesh=mesh)
+        self.mesh = getattr(self.store, "mesh", mesh)
         self.n_docs = n_docs
         self._init_row_caches(n_docs)
         self._col_part = 0
@@ -1369,13 +1376,23 @@ class MapServingEngine(ServingEngineBase):
             seq_base.astype("<i4"),
             rows.astype("<i4"),
         ])
-        from ..ops.map_kernel import map_columnar_apply_jit
         scatter = not (R == self.n_docs
                        and np.array_equal(rows, np.arange(R)))
         import jax.numpy as jnp
-        self.store.state = map_columnar_apply_jit(
-            self.store.state, jnp.asarray(buf), R=R, O=O,
-            n_docs=self.n_docs, scatter_rows=scatter, wide_vals=wide_vals)
+        if getattr(self.store, "mesh", None) is not None:
+            from ..ops.map_kernel import map_columnar_unpack_jit
+            from ..parallel.sharded import sharded_map_merge
+            planes = map_columnar_unpack_jit(
+                jnp.asarray(buf), R=R, O=O, n_docs=self.n_docs,
+                scatter_rows=scatter, wide_vals=wide_vals)
+            self.store.state = sharded_map_merge(self.store.mesh)(
+                self.store.state, planes)
+        else:
+            from ..ops.map_kernel import map_columnar_apply_jit
+            self.store.state = map_columnar_apply_jit(
+                self.store.state, jnp.asarray(buf), R=R, O=O,
+                n_docs=self.n_docs, scatter_rows=scatter,
+                wide_vals=wide_vals)
 
         # whole-batch durable record (host work rides under the device
         # apply); nacked batches fall back to per-partition grouping is
@@ -1458,11 +1475,12 @@ class MapServingEngine(ServingEngineBase):
         return summary
 
     @classmethod
-    def load(cls, summary: dict, log: PartitionedLog,
+    def load(cls, summary: dict, log: PartitionedLog, mesh=None,
              **kwargs) -> "MapServingEngine":
         """Summary + tail replay through the same apply path (the single
-        recovery primitive, as in the string engine)."""
-        store = TensorMapStore.restore(summary["store"])
+        recovery primitive, as in the string engine). ``mesh`` re-shards
+        the restored planes."""
+        store = TensorMapStore.restore(summary["store"], mesh=mesh)
         engine = cls(store.n_docs, store.n_keys, log=log, store=store,
                      **kwargs)
         engine._restore_base(summary)

@@ -100,3 +100,81 @@ def test_mesh_requires_divisible_docs():
     from fluidframework_tpu.ops.string_store import TensorStringStore
     with pytest.raises(ValueError, match="divisible"):
         TensorStringStore(30, 128, mesh=mesh)
+
+
+def test_sharded_incremental_summary_roundtrip():
+    """Incremental summaries of a SHARDED store: the dirty-row gather and
+    the delta-restore scatter must work over the mesh, and load(mesh=...)
+    must resolve the chain back onto it."""
+    R, O = 64, 8
+    mesh, eng, ora, docs, rows = _pair(R)
+    client = np.ones((R, O), np.int32)
+    z = np.zeros((R, O), np.int32)
+    kind = np.zeros((R, O), np.int32)
+    cseq = np.broadcast_to(np.arange(1, O + 1, dtype=np.int32), (R, O))
+    assert eng.ingest_planes(rows, client, cseq, z, kind, z, z,
+                             TEXT)["nacked"] == 0
+    eng.summarize()
+    # touch 3 docs, delta-summarize, touch 2 more, delta again (chain)
+    sub = rows[:3]
+    cseq2 = np.broadcast_to(np.arange(O + 1, 2 * O + 1, dtype=np.int32),
+                            (3, O))
+    assert eng.ingest_planes(sub, client[:3], cseq2, z[:3], kind[:3],
+                             z[:3], z[:3], TEXT)["nacked"] == 0
+    s1 = eng.summarize(incremental=True)
+    assert len(s1["store_delta"]["rows"]) == 3
+    sub2 = rows[10:12]
+    cseq3 = np.broadcast_to(np.arange(O + 1, 2 * O + 1, dtype=np.int32),
+                            (2, O))
+    assert eng.ingest_planes(sub2, client[:2], cseq3, z[:2], kind[:2],
+                             z[:2], z[:2], TEXT)["nacked"] == 0
+    s2 = eng.summarize(incremental=True)
+    want = {d: eng.read_text(d) for d in docs}
+    revived = StringServingEngine.load(s2, eng.log, mesh=mesh)
+    assert {d: revived.read_text(d) for d in docs} == want
+    assert "docs" in str(revived.store.state.seq.sharding.spec)
+
+
+def test_sharded_map_engine_matches_unsharded():
+    """MapServingEngine(mesh=...): columnar merge as a collective-free
+    shard_map; parity with the unsharded engine + recovery onto mesh."""
+    from fluidframework_tpu.ops.schema import OpKind
+    from fluidframework_tpu.server.serving import MapServingEngine
+    mesh = make_doc_mesh(8)
+    R, O = 64, 12
+    a = MapServingEngine(n_docs=R, batch_window=10 ** 9,
+                         sequencer="native", mesh=mesh)
+    b = MapServingEngine(n_docs=R, batch_window=10 ** 9,
+                         sequencer="native")
+    docs = [f"sm-{i}" for i in range(R)]
+    for e in (a, b):
+        for d in docs:
+            e.connect(d, 1)
+            e.doc_row(d)
+    rows = np.array([a.doc_row(d) for d in docs], np.int32)
+    rng = np.random.default_rng(3)
+    keys = [f"k{j}" for j in range(6)]
+    values = [f"v{j}" for j in range(5)]
+    client = np.ones((R, O), np.int32)
+    ref = np.zeros((R, O), np.int32)
+    for bi in range(3):
+        kind = rng.choice([int(OpKind.MAP_SET), int(OpKind.MAP_DELETE),
+                           int(OpKind.MAP_CLEAR)],
+                          p=[0.8, 0.15, 0.05], size=(R, O)).astype(np.int32)
+        kidx = rng.integers(0, len(keys), size=(R, O)).astype(np.int32)
+        vidx = rng.integers(0, len(values), size=(R, O)).astype(np.int32)
+        cseq = np.broadcast_to(
+            np.arange(bi * O + 1, (bi + 1) * O + 1, dtype=np.int32), (R, O))
+        for e in (a, b):
+            assert e.ingest_planes(rows, client, cseq, ref, kind, kidx,
+                                   keys, values, vidx)["nacked"] == 0
+    assert np.array_equal(a.store.digests(), b.store.digests())
+    for d in docs[::11]:
+        assert a.read_doc(d) == b.read_doc(d), d
+    assert "docs" in str(a.store.state.present.sharding.spec)
+
+    summary = a.summarize()
+    revived = MapServingEngine.load(summary, a.log, mesh=mesh)
+    assert {d: revived.read_doc(d) for d in docs} == \
+        {d: a.read_doc(d) for d in docs}
+    assert "docs" in str(revived.store.state.present.sharding.spec)
