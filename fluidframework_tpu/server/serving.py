@@ -236,6 +236,44 @@ class ServingEngineBase:
                             "doc_row before columnar ingest)")
                     self._row_handle[r] = raw.doc_handle(self._row_doc_id[r])
 
+    # ------------------------------------------ shared columnar protocol
+    # The sequencing/durability invariants every engine's columnar ingest
+    # must uphold, held in ONE place: sequence the raw batch in one native
+    # call, then POISON the engine until its whole-batch durable record is
+    # appended (any failure in between leaves doc.seq — and possibly
+    # device state — ahead of the log; a summary taken then would persist
+    # ops the log never recorded).
+
+    def _sequence_columnar(self, raw, handles, client, client_seq,
+                           ref_seq, what: str):
+        """One native sequencing call + the poison sentinel + nack
+        metrics. Returns (out_seq, out_min, nacked mask)."""
+        out_seq, out_min = raw.sequence_batch_rows(
+            handles, client, client_seq, ref_seq)
+        self._poisoned = f"{what} failed after sequencing"
+        nacked = out_seq < 0
+        n_ok = int((~nacked).sum())
+        self.metrics.inc("ops_ingested", n_ok)
+        if nacked.any():
+            self.metrics.inc("nacks", int(nacked.sum()))
+        return out_seq, out_min, nacked
+
+    @staticmethod
+    def _clamped_ref(ref_flat: np.ndarray, out_seq: np.ndarray):
+        """The logged ref_seq is the CLAMPED one (min(ref, seq-1), what
+        the sequencer recorded): replaying a raw inflated ref would push
+        a client's ref past doc.seq and permanently nack later ops."""
+        return np.minimum(ref_flat.astype(np.int64),
+                          np.maximum(out_seq - 1, 0))
+
+    def _append_columnar(self, record: "ColumnarOps") -> None:
+        """Whole-batch durable append (round-robin partition for balance)
+        + poison clear: sequence → merge → log completed."""
+        p = self._col_part
+        self._col_part = (p + 1) % self.log.n_partitions
+        self.log.append(int(p), record)
+        self._poisoned = None
+
     def connect(self, doc_id: str, client_id: int
                 ) -> SequencedDocumentMessage:
         # row allocation is lazy (first op/read), so a JOIN never pins the
@@ -690,20 +728,11 @@ class StringServingEngine(ServingEngineBase):
         flat = lambda p: np.ascontiguousarray(np.asarray(p, np.int32)
                                               .reshape(-1))
         handles = np.repeat(self._row_handle[rows], O)
-        out_seq, out_min = raw.sequence_batch_rows(
-            handles, flat(client), flat(client_seq), flat(ref_seq))
+        out_seq, out_min, nacked = self._sequence_columnar(
+            raw, handles, flat(client), flat(client_seq), flat(ref_seq),
+            "columnar batch")
         _t_seq = time.perf_counter()
-        # poison-by-default from here to the end of the log append: ANY
-        # failure in between (device apply, packing, a partition append)
-        # leaves doc.seq — and possibly device state — ahead of the
-        # durable log; a summary taken then would durably persist ops the
-        # log never recorded. Cleared only when the append loop completes.
-        self._poisoned = "columnar batch failed after sequencing"
-        nacked = out_seq < 0
         n_ok = int((~nacked).sum())
-        self.metrics.inc("ops_ingested", n_ok)
-        if nacked.any():
-            self.metrics.inc("nacks", int(nacked.sum()))
 
         # device merge FIRST (async dispatch — see docstring): nacked slots
         # become NOOP (they consumed no seq); the store rebuilds per-op seqs
@@ -736,27 +765,19 @@ class StringServingEngine(ServingEngineBase):
             texts=texts, tidx=tidx, props=props)
         _t_apply = time.perf_counter()
 
-        # durable log (host work, overlapped with the device apply). The
-        # logged ref_seq is the CLAMPED one (min(ref, seq-1), what the
-        # sequencer recorded): replaying a raw inflated ref would push a
-        # client's ref_seq past doc.seq after recovery and permanently nack
-        # every later op (the clamp invariant in sequence_on).
+        # durable log (host work, overlapped with the device apply)
         ts = self.deli.clock()
         rowidx = np.repeat(np.arange(R, dtype=np.int32), O)
         ids = [self._row_doc_id[r] for r in rows]
         flat_client = flat(client)
-        ref_clamped = np.minimum(flat(ref_seq).astype(np.int64),
-                                 np.maximum(out_seq - 1, 0))
+        ref_clamped = self._clamped_ref(flat(ref_seq), out_seq)
         if not nacked.any():
             # hot path: the whole batch is ONE ColumnarOps record (the
             # Kafka-batch analog) — no partition sort, no per-field
-            # gathers. Batch records round-robin across partitions for
-            # balance; a doc's columnar history is reassembled seq-ordered
+            # gathers; a doc's columnar history is reassembled seq-ordered
             # at read (_doc_log_messages scans all partitions — recovery
             # only). Copies detach the log from caller-owned planes.
-            p = self._col_part
-            self._col_part = (p + 1) % self.log.n_partitions
-            self.log.append(int(p), ColumnarOps(
+            self._append_columnar(ColumnarOps(
                 ids, rowidx, flat_client.copy(), flat(client_seq).copy(),
                 ref_clamped, out_seq, out_min, kind.reshape(-1).copy(),
                 flat(a0).copy(), flat(a1).copy(), text=text, timestamp=ts,
@@ -786,7 +807,7 @@ class StringServingEngine(ServingEngineBase):
                     ids, row_sorted[sl], *(g[sl] for g in gathered),
                     text=text, timestamp=ts, texts=texts, props=props,
                     tidx=None if tidx_flat is None else tidx_flat[sl]))
-        self._poisoned = None  # sequence → merge → log completed
+            self._poisoned = None  # sequence → merge → log completed
         # per-stage host wall (the throughput breakdown): C++ sequencing,
         # plane prep + wire packing, async device dispatch, log append —
         # device time itself is covered by the caller's end sync
@@ -1340,14 +1361,10 @@ class MapServingEngine(ServingEngineBase):
         flat = lambda p: np.ascontiguousarray(np.asarray(p, np.int32)
                                               .reshape(-1))
         handles = np.repeat(self._row_handle[rows], O)
-        out_seq, out_min = raw.sequence_batch_rows(
-            handles, flat(client), flat(client_seq), flat(ref_seq))
-        self._poisoned = "columnar batch failed after sequencing"
-        nacked = out_seq < 0
+        out_seq, out_min, nacked = self._sequence_columnar(
+            raw, handles, flat(client), flat(client_seq), flat(ref_seq),
+            "columnar map batch")
         n_ok = int((~nacked).sum())
-        self.metrics.inc("ops_ingested", n_ok)
-        if nacked.any():
-            self.metrics.inc("nacks", int(nacked.sum()))
         valid_rs = (~nacked).reshape(R, O)
         kind_eff = np.where(valid_rs, kind, int(OpKind.NOOP))
         seq_rs = out_seq.reshape(R, O)
@@ -1400,12 +1417,9 @@ class MapServingEngine(ServingEngineBase):
         ts = self.deli.clock()
         rowidx = np.repeat(np.arange(R, dtype=np.int32), O)
         ids = [self._row_doc_id[r] for r in rows]
-        ref_clamped = np.minimum(flat(ref_seq).astype(np.int64),
-                                 np.maximum(out_seq - 1, 0))
+        ref_clamped = self._clamped_ref(flat(ref_seq), out_seq)
         ok = ~nacked
-        p = self._col_part
-        self._col_part = (p + 1) % self.log.n_partitions
-        self.log.append(int(p), ColumnarOps(
+        self._append_columnar(ColumnarOps(
             ids, rowidx[ok], flat(client)[ok], flat(client_seq)[ok],
             ref_clamped[ok], out_seq[ok], out_min[ok],
             kind.reshape(-1)[ok], flat(kidx)[ok],
@@ -1413,7 +1427,6 @@ class MapServingEngine(ServingEngineBase):
              else np.zeros(R * O, np.int32))[ok],
             text="", timestamp=ts, family="map", keys=list(keys),
             values=list(values) if values is not None else []))
-        self._poisoned = None
         last_min = out_min.reshape(R, O)[:, -1]
         for i, r in enumerate(rows):
             self._min_seq[self._row_doc_id[r]] = int(last_min[i])
@@ -1748,14 +1761,9 @@ class MatrixServingEngine(ServingEngineBase):
         t0 = time.perf_counter()
         cseq = np.ascontiguousarray(client_seqs, np.int32)
         ref = np.ascontiguousarray(ref_seqs, np.int32)
-        out_seq, out_min = raw.sequence_batch_rows(
-            self._row_handle[rows], client, cseq, ref)
-        self._poisoned = "cell batch failed after sequencing"
-        nacked = out_seq < 0
+        out_seq, out_min, nacked = self._sequence_columnar(
+            raw, self._row_handle[rows], client, cseq, ref, "cell batch")
         n_ok = int((~nacked).sum())
-        self.metrics.inc("ops_ingested", n_ok)
-        if nacked.any():
-            self.metrics.inc("nacks", int(nacked.sum()))
         ok = np.flatnonzero(~nacked)
 
         # one resolve-only axis scan for every accepted op
@@ -1808,11 +1816,8 @@ class MatrixServingEngine(ServingEngineBase):
         ts = self.deli.clock()
         id_tab = sorted(set(doc_ids))
         id_of = {d: i for i, d in enumerate(id_tab)}
-        ref_clamped = np.minimum(ref.astype(np.int64),
-                                 np.maximum(out_seq - 1, 0))
-        p = self._col_part
-        self._col_part = (p + 1) % self.log.n_partitions
-        self.log.append(int(p), ColumnarOps(
+        ref_clamped = self._clamped_ref(ref, out_seq)
+        self._append_columnar(ColumnarOps(
             id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
                                 count=len(ok)),
             client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
@@ -1820,7 +1825,6 @@ class MatrixServingEngine(ServingEngineBase):
             np.arange(len(ok), dtype=np.int32),
             np.zeros(len(ok), np.int32),
             text="", timestamp=ts, family="ops", values=contents_tab))
-        self._poisoned = None
         for i in ok:
             self._min_seq[doc_ids[i]] = int(out_min[i])
         self.metrics.inc("flushes")
@@ -2121,14 +2125,9 @@ class TreeServingEngine(ServingEngineBase):
         client = np.ascontiguousarray(clients, np.int32)
         cseq = np.ascontiguousarray(client_seqs, np.int32)
         ref = np.ascontiguousarray(ref_seqs, np.int32)
-        out_seq, out_min = raw.sequence_batch_rows(handles, client, cseq,
-                                                   ref)
-        self._poisoned = "tree batch failed after sequencing"
-        nacked = out_seq < 0
+        out_seq, out_min, nacked = self._sequence_columnar(
+            raw, handles, client, cseq, ref, "tree batch")
         n_ok = int((~nacked).sum())
-        self.metrics.inc("ops_ingested", n_ok)
-        if nacked.any():
-            self.metrics.inc("nacks", int(nacked.sum()))
 
         ok = np.flatnonzero(~nacked)
         ts = self.deli.clock()
@@ -2149,11 +2148,8 @@ class TreeServingEngine(ServingEngineBase):
         # ONE whole-batch record: the op dicts ride the values table
         id_tab = sorted(set(doc_ids))
         id_of = {d: i for i, d in enumerate(id_tab)}
-        p = self._col_part
-        self._col_part = (p + 1) % self.log.n_partitions
-        ref_clamped = np.minimum(ref.astype(np.int64),
-                                 np.maximum(out_seq - 1, 0))
-        self.log.append(int(p), ColumnarOps(
+        ref_clamped = self._clamped_ref(ref, out_seq)
+        self._append_columnar(ColumnarOps(
             id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
                                 count=len(ok)),
             client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
@@ -2163,7 +2159,6 @@ class TreeServingEngine(ServingEngineBase):
             text="", timestamp=ts, family="ops",
             values=[ops[i] for i in ok],
             keys=None))
-        self._poisoned = None
         self.metrics.inc("flushes")
         self.metrics.inc("ops_flushed", n_ok)
         self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
