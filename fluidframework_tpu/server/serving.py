@@ -169,6 +169,9 @@ class ServingEngineBase:
         # per-lambda observability (SURVEY.md §5.5: op rate, nacks by
         # reason, flush batch sizes, flush latency percentiles)
         self.metrics = MetricsCollector()
+        # round-robin partition cursor for whole-batch columnar records
+        # (see _append_columnar)
+        self._col_part = 0
         # set when the device state may be AHEAD of the durable log (a
         # log append failed after the merge was dispatched): every ingest
         # and summary refuses until the engine is rebuilt via load() —
@@ -247,7 +250,7 @@ class ServingEngineBase:
     def _sequence_columnar(self, raw, handles, client, client_seq,
                            ref_seq, what: str):
         """One native sequencing call + the poison sentinel + nack
-        metrics. Returns (out_seq, out_min, nacked mask)."""
+        metrics. Returns (out_seq, out_min, nacked mask, n_ok)."""
         out_seq, out_min = raw.sequence_batch_rows(
             handles, client, client_seq, ref_seq)
         self._poisoned = f"{what} failed after sequencing"
@@ -256,7 +259,7 @@ class ServingEngineBase:
         self.metrics.inc("ops_ingested", n_ok)
         if nacked.any():
             self.metrics.inc("nacks", int(nacked.sum()))
-        return out_seq, out_min, nacked
+        return out_seq, out_min, nacked, n_ok
 
     @staticmethod
     def _clamped_ref(ref_flat: np.ndarray, out_seq: np.ndarray):
@@ -469,8 +472,6 @@ class StringServingEngine(ServingEngineBase):
         self.store = store if store is not None \
             else TensorStringStore(n_docs, capacity, n_props, mesh=mesh)
         self.mesh = getattr(self.store, "mesh", mesh)
-        # round-robin partition cursor for whole-batch columnar records
-        self._col_part = 0
         # in-flight async overflow-flag copy (deferred harvest; see
         # ingest_planes' compact-due branch)
         self._ov_pending = None
@@ -728,11 +729,10 @@ class StringServingEngine(ServingEngineBase):
         flat = lambda p: np.ascontiguousarray(np.asarray(p, np.int32)
                                               .reshape(-1))
         handles = np.repeat(self._row_handle[rows], O)
-        out_seq, out_min, nacked = self._sequence_columnar(
+        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
             raw, handles, flat(client), flat(client_seq), flat(ref_seq),
             "columnar batch")
         _t_seq = time.perf_counter()
-        n_ok = int((~nacked).sum())
 
         # device merge FIRST (async dispatch — see docstring): nacked slots
         # become NOOP (they consumed no seq); the store rebuilds per-op seqs
@@ -1273,7 +1273,6 @@ class MapServingEngine(ServingEngineBase):
         self.mesh = getattr(self.store, "mesh", mesh)
         self.n_docs = n_docs
         self._init_row_caches(n_docs)
-        self._col_part = 0
         # per-(rows, key-vocabulary) key-slot lut cache: steady-state
         # ingest with a stable vocabulary pays zero interning dict hits
         self._lut_cache: Optional[tuple] = None
@@ -1361,10 +1360,9 @@ class MapServingEngine(ServingEngineBase):
         flat = lambda p: np.ascontiguousarray(np.asarray(p, np.int32)
                                               .reshape(-1))
         handles = np.repeat(self._row_handle[rows], O)
-        out_seq, out_min, nacked = self._sequence_columnar(
+        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
             raw, handles, flat(client), flat(client_seq), flat(ref_seq),
             "columnar map batch")
-        n_ok = int((~nacked).sum())
         valid_rs = (~nacked).reshape(R, O)
         kind_eff = np.where(valid_rs, kind, int(OpKind.NOOP))
         seq_rs = out_seq.reshape(R, O)
@@ -1548,7 +1546,6 @@ class MatrixServingEngine(ServingEngineBase):
         self._cell_meta: Dict[int, Dict] = {}
         self._pending_setcells = 0  # queued setCells (capacity reservation)
         self._init_row_caches(n_docs)
-        self._col_part = 0
         # conservative per-axis slot usage bound (each admitted axis op
         # adds at most 2 slots: an insert, or a remove's two splits);
         # re-based to the measured device counts at every compact()
@@ -1761,9 +1758,8 @@ class MatrixServingEngine(ServingEngineBase):
         t0 = time.perf_counter()
         cseq = np.ascontiguousarray(client_seqs, np.int32)
         ref = np.ascontiguousarray(ref_seqs, np.int32)
-        out_seq, out_min, nacked = self._sequence_columnar(
+        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
             raw, self._row_handle[rows], client, cseq, ref, "cell batch")
-        n_ok = int((~nacked).sum())
         ok = np.flatnonzero(~nacked)
 
         # one resolve-only axis scan for every accepted op
@@ -1979,7 +1975,6 @@ class TreeServingEngine(ServingEngineBase):
         self.n_docs = n_docs
         self.capacity = self.store.capacity
         self._init_row_caches(n_docs)
-        self._col_part = 0
         # terminal tier: docs too big for the batched store, each in its
         # own single-doc store sharing the main store's interners
         self._graduated: Dict[str, Any] = {}
@@ -2125,9 +2120,8 @@ class TreeServingEngine(ServingEngineBase):
         client = np.ascontiguousarray(clients, np.int32)
         cseq = np.ascontiguousarray(client_seqs, np.int32)
         ref = np.ascontiguousarray(ref_seqs, np.int32)
-        out_seq, out_min, nacked = self._sequence_columnar(
+        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
             raw, handles, client, cseq, ref, "tree batch")
-        n_ok = int((~nacked).sum())
 
         ok = np.flatnonzero(~nacked)
         ts = self.deli.clock()
