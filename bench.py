@@ -447,9 +447,47 @@ def run():
     _ = np.asarray(tree_eng.store.state.node_id)
     tree_ops_per_sec = n_tree_docs * n_tree_waves / (
         time.perf_counter() - t0)
+    # the tree VOLUME path: vectorized flat-insert ingest (no per-op
+    # translation). The tree kernel scan is device-bound per batch, so
+    # the volume path runs at 4× the doc batch (throughput scales with
+    # docs merged in parallel).
+    n_leaf_docs = 4 * n_tree_docs
+    ldocs = [f"tf-{i}" for i in range(n_leaf_docs)]
+    leaves_eng = TreeServingEngine(n_docs=n_leaf_docs, capacity=128,
+                                   batch_window=10 ** 9,
+                                   sequencer="native")
+    for d in ldocs:
+        leaves_eng.connect(d, 1)
+    ones = [1] * n_leaf_docs
+    leaves_eng.ingest_leaves(  # warmup (compiles the flat apply)
+        ldocs, ones, ones, [0] * n_leaf_docs, ["root"] * n_leaf_docs,
+        ["kids"] * n_leaf_docs, [f"{d}-f0" for d in ldocs],
+        [0] * n_leaf_docs)
+    _ = np.asarray(leaves_eng.store.state.node_id)
+    n_leaf_waves = 6
+    t0 = time.perf_counter()
+    for wave in range(1, n_leaf_waves + 1):
+        res = leaves_eng.ingest_leaves(
+            ldocs, ones, [wave + 1] * n_leaf_docs, [0] * n_leaf_docs,
+            ["root"] * n_leaf_docs, ["kids"] * n_leaf_docs,
+            [f"{d}-f{wave}" for d in ldocs], [wave] * n_leaf_docs,
+            afters=[f"{d}-f{wave - 1}" for d in ldocs])
+        assert res["nacked"] == 0
+    _ = np.asarray(leaves_eng.store.state.node_id)
+    tree_flat_ops_per_sec = n_leaf_docs * n_leaf_waves / (
+        time.perf_counter() - t0)
+    # parity: the flat path's log must rebuild the oracle state too
+    from fluidframework_tpu.models.shared_tree import SharedTree
+    probe_f = ldocs[7]
+    oracle_f = SharedTree(probe_f, 999)
+    for m in leaves_eng._doc_log_messages(probe_f):
+        oracle_f.process_core(m, local=False)
+    assert leaves_eng.to_dict(probe_f) == oracle_f.to_dict(), \
+        "tree flat-ingest divergence vs oracle"
+    del leaves_eng
+
     # oracle parity: replay the sampled doc's full log history through the
     # pure-Python SharedTree oracle
-    from fluidframework_tpu.models.shared_tree import SharedTree
     probe = tdocs[n_tree_docs // 2]
     oracle = SharedTree(probe, 999)
     for m in tree_eng._doc_log_messages(probe):
@@ -568,6 +606,7 @@ def run():
         "serving_durable_ops_per_sec":
             round(durable_ops_per_sec, 1) if durable_ops_per_sec else None,
         "tree_serving_ops_per_sec": round(tree_ops_per_sec, 1),
+        "tree_flat_serving_ops_per_sec": round(tree_flat_ops_per_sec, 1),
         "ack_p50_ms": round(ack_p50_ms, 1),
         "ack_p99_ms": round(ack_p99_ms, 1),
         "serving_read_ms": round(serving_read_ms, 1),

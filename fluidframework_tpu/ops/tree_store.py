@@ -184,6 +184,59 @@ class TensorTreeStore:
             jnp.asarray(planes[6]), jnp.asarray(planes[8]),
             jnp.asarray(planes[7]))
 
+    def apply_flat_inserts(self, rows, slot_of_row, parents, fields,
+                           node_ids, afters, values, types, seqs) -> None:
+        """Vectorized apply of N FLAT single-node inserts (op i creates
+        ``node_ids[i]`` under ``parents[i]``/``fields[i]`` after
+        ``afters[i]`` in doc row ``rows[i]``): the per-op record stream
+        is a fixed 4-record pattern (TXN_BEGIN, INS_BEGIN, GUARD_ABSENT,
+        INSERT), so the planes build as strided numpy writes — no per-op
+        Python translation loop. ``slot_of_row[i]`` is op i's position
+        among its doc's ops this batch (callers group by doc)."""
+        n = len(node_ids)
+        nid = np.fromiter((self._ids.handle(x) for x in node_ids),
+                          np.int32, count=n)
+        par = np.fromiter((self._ids.handle(x) for x in parents),
+                          np.int32, count=n)
+        aft = np.fromiter(
+            (self._ids.handle(x) if x else 0 for x in afters),
+            np.int32, count=n)
+        fld = np.fromiter((self._fields.handle(x) for x in fields),
+                          np.int32, count=n)
+        val = np.fromiter((self._vh(v) for v in values), np.int32,
+                          count=n)
+        typ = np.fromiter((self._th(t) for t in types), np.int32,
+                          count=n)
+        width = int(np.max(slot_of_row)) + 1 if n else 1
+        o = 8
+        while o < 4 * width:
+            o *= 2
+        planes = np.zeros((9, self.n_docs, o), np.int32)
+        base = np.asarray(slot_of_row, np.int64) * 4
+        rws = np.asarray(rows, np.int64)
+        # record pattern per op: kind plane gets [TXN_BEGIN, INS_BEGIN,
+        # GUARD_ABSENT, INSERT]; id/attr planes light up per record role
+        planes[0, rws, base + 0] = int(TreeOpKind.TXN_BEGIN)
+        planes[0, rws, base + 1] = int(TreeOpKind.INS_BEGIN)
+        planes[0, rws, base + 2] = int(TreeOpKind.INS_GUARD_ABSENT)
+        planes[0, rws, base + 3] = int(TreeOpKind.INSERT)
+        planes[1, rws, base + 2] = nid       # guard target
+        planes[1, rws, base + 3] = nid       # inserted node
+        planes[2, rws, base + 3] = par
+        planes[3, rws, base + 3] = aft
+        planes[4, rws, base + 3] = fld
+        planes[5, rws, base + 3] = val
+        planes[6, rws, base + 3] = typ
+        sq = np.asarray(seqs, np.int64)
+        for k in range(4):
+            planes[8, rws, base + k] = sq
+        self.state = apply_tree_batch_jit(
+            self.state, jnp.asarray(planes[0]), jnp.asarray(planes[1]),
+            jnp.asarray(planes[2]), jnp.asarray(planes[3]),
+            jnp.asarray(planes[4]), jnp.asarray(planes[5]),
+            jnp.asarray(planes[6]), jnp.asarray(planes[8]),
+            jnp.asarray(planes[7]))
+
     # ----------------------------------------------------------------- reads
 
     def _pull(self, doc: int) -> dict:

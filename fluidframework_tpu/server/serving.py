@@ -106,7 +106,15 @@ class ColumnarOps:
         out = []
         for i in idxs:
             k = int(self.kind[i])
-            if self.family in ("ops", "tree"):
+            if self.family == "tree_flat":
+                # flat single-node insert: values[i] = [parent, field,
+                # node_id, after, value, type]
+                p, f, nid, aft, val, typ = self.values[int(self.a0[i])]
+                contents = {"op": "insert", "parent": p, "field": f,
+                            "after": aft or None,
+                            "nodes": [{"id": nid, "type": typ,
+                                       "value": val}]}
+            elif self.family in ("ops", "tree"):
                 # generic op-dict batch: contents ride the values table
                 contents = self.values[int(self.a0[i])]
             elif self.family == "map":
@@ -2088,6 +2096,96 @@ class TreeServingEngine(ServingEngineBase):
         return n
 
     # ------------------------------------------------------- columnar ingest
+
+    def ingest_leaves(self, doc_ids: List[str], clients, client_seqs,
+                      ref_seqs, parents: List[str], fields: List[str],
+                      node_ids: List[str], values: list,
+                      types: Optional[List[str]] = None,
+                      afters: Optional[List[Optional[str]]] = None
+                      ) -> dict:
+        """The tree volume path: N FLAT single-node inserts (op i creates
+        ``node_ids[i]`` under ``parents[i]``/``fields[i]``) — one native
+        sequencing call, one VECTORIZED device apply (no per-op dict
+        translation anywhere), one whole-batch durable record (family
+        "tree_flat"). General edits (transactions, moves, removes,
+        subtree specs) go through ``ingest_batch``/``submit``."""
+        self._check_poisoned()
+        raw = getattr(self.deli, "raw", None)
+        if raw is None:
+            raise RuntimeError("batch ingest requires sequencer='native'")
+        n = len(node_ids)
+        types = types if types is not None else [None] * n
+        afters = afters if afters is not None else [None] * n
+        if not (len(doc_ids) == len(clients) == len(client_seqs)
+                == len(ref_seqs) == len(parents) == len(fields)
+                == len(values) == len(types) == len(afters) == n):
+            raise ValueError("batch fields must have equal length")
+        for lst, what in ((parents, "parent"), (fields, "field"),
+                          (node_ids, "node id")):
+            if not all(isinstance(x, str) and x for x in lst):
+                raise ValueError(f"every {what} must be a non-empty str")
+        if not all(t is None or isinstance(t, str) for t in types):
+            raise ValueError("every type must be a str or None")
+        if not all(a is None or (isinstance(a, str) and a)
+                   for a in afters):
+            raise ValueError("every after must be a non-empty str or None")
+        try:  # values land in the log's JSON extras and the interner
+            # (sort_keys matches ValueInterner's canonical encoding — a
+            # value only dumps-able unsorted would crash post-sequencing)
+            json.dumps(values, sort_keys=True)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"unserializable node value: {e}") from None
+        if self._graduated and any(d in self._graduated for d in doc_ids):
+            raise ValueError("a targeted doc has graduated off the flat "
+                             "tier; route its ops through submit()")
+        self.flush()
+        rows = np.fromiter((self.doc_row(d) for d in doc_ids), np.int32,
+                           count=n)
+        self._fill_row_handles(np.unique(rows), raw)
+        t0 = time.perf_counter()
+        client = np.ascontiguousarray(clients, np.int32)
+        cseq = np.ascontiguousarray(client_seqs, np.int32)
+        ref = np.ascontiguousarray(ref_seqs, np.int32)
+        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
+            raw, self._row_handle[rows], client, cseq, ref,
+            "tree leaves batch")
+        ok = np.flatnonzero(~nacked)
+        if len(ok):
+            rows_ok = rows[ok]
+            # per-doc op position (ops of one doc stay in list order)
+            order = np.argsort(rows_ok, kind="stable")
+            r_sorted = rows_ok[order]
+            starts = np.r_[0, np.flatnonzero(np.diff(r_sorted)) + 1]
+            sizes = np.diff(np.r_[starts, len(r_sorted)])
+            slot_sorted = np.arange(len(r_sorted)) \
+                - np.repeat(starts, sizes)
+            slot = np.empty_like(slot_sorted)
+            slot[order] = slot_sorted
+            take = lambda lst: [lst[i] for i in ok]
+            self.store.apply_flat_inserts(
+                rows_ok, slot, take(parents), take(fields),
+                take(node_ids), take(afters), take(values), take(types),
+                out_seq[ok])
+        ts = self.deli.clock()
+        id_tab = sorted(set(doc_ids))
+        id_of = {d: i for i, d in enumerate(id_tab)}
+        ref_clamped = self._clamped_ref(ref, out_seq)
+        self._append_columnar(ColumnarOps(
+            id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
+                                count=len(ok)),
+            client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
+            out_min[ok], np.zeros(len(ok), np.int32),
+            np.arange(len(ok), dtype=np.int32),
+            np.zeros(len(ok), np.int32),
+            text="", timestamp=ts, family="tree_flat",
+            values=[[parents[i], fields[i], node_ids[i],
+                     afters[i] or "", values[i], types[i]] for i in ok]))
+        for i in ok:
+            self._min_seq[doc_ids[i]] = int(out_min[i])
+        self.metrics.inc("flushes")
+        self.metrics.inc("ops_flushed", n_ok)
+        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        return {"seq": out_seq, "nacked": int(nacked.sum())}
 
     def ingest_batch(self, doc_ids: List[str], clients, client_seqs,
                      ref_seqs, ops: List[dict]) -> dict:

@@ -116,3 +116,101 @@ def test_tree_batch_overflow_recovery_expands_columnar():
     report = eng.recover_overflowed()
     assert report.get(d) == "graduated", report
     assert eng.node_count(d) == 151  # root + 150 inserts, none lost
+
+
+def test_tree_leaves_matches_per_op_engine():
+    """ingest_leaves (the vectorized flat-insert path) must match the
+    per-op submit path node for node, including sibling order."""
+    eng, ora, docs = _mk(R=8)
+    for wave in range(3):
+        ids = list(docs)
+        parents = ["root"] * len(ids)
+        fields = ["kids"] * len(ids)
+        nodes = [f"{d}-L{wave}" for d in ids]
+        vals = [{"w": wave, "d": d} for d in ids]
+        typs = ["leaf"] * len(ids)
+        afters = [None if wave == 0 else f"{d}-L{wave - 1}" for d in ids]
+        res = eng.ingest_leaves(ids, [1] * len(ids),
+                                [wave + 1] * len(ids), [0] * len(ids),
+                                parents, fields, nodes, vals, typs,
+                                afters)
+        assert res["nacked"] == 0
+        for i, d in enumerate(ids):
+            _, nack = ora.submit(d, 1, wave + 1, 0,
+                                 {"op": "insert", "parent": "root",
+                                  "field": "kids", "after": afters[i],
+                                  "nodes": [{"id": nodes[i],
+                                             "type": "leaf",
+                                             "value": vals[i]}]})
+            assert nack is None
+    for d in docs:
+        assert eng.to_dict(d) == ora.to_dict(d), d
+    assert np.array_equal(eng.store.digests(), ora.store.digests())
+
+
+def test_tree_leaves_recovery_and_mixing():
+    """Leaves batches must replay from the log (family tree_flat) and
+    interleave with per-op submits and general batches."""
+    eng, _, docs = _mk(R=4)
+    summary = eng.summarize()
+    ids = list(docs)
+    res = eng.ingest_leaves(ids, [1] * 4, [1] * 4, [0] * 4,
+                            ["root"] * 4, ["kids"] * 4,
+                            [f"{d}-a" for d in ids], [1] * 4)
+    assert res["nacked"] == 0
+    # general batch and per-op submit continue the same seq space
+    doc_ids, ops = _ops_wave(docs, 1)
+    ops = [{"op": "setValue", "id": f"{d}-a", "value": "set"}
+           for d in doc_ids]
+    assert eng.ingest_batch(doc_ids, [1] * 4, [2] * 4, [0] * 4,
+                            ops)["nacked"] == 0
+    _, nack = eng.submit(docs[0], 1, 3, 0,
+                         {"op": "insert", "parent": f"{docs[0]}-a",
+                          "field": "sub", "after": None,
+                          "nodes": [{"id": f"{docs[0]}-b",
+                                     "type": None, "value": 9}]})
+    assert nack is None
+    want = {d: eng.to_dict(d) for d in docs}
+    revived = TreeServingEngine.load(summary, eng.log)
+    assert {d: revived.to_dict(d) for d in docs} == want
+
+
+def test_tree_leaves_validation_and_nacks():
+    eng, _, docs = _mk(R=2)
+    seq_before = {d: eng.deli.doc_seq(d) for d in docs}
+    with pytest.raises(ValueError, match="non-empty str"):
+        eng.ingest_leaves([docs[0]], [1], [1], [0], [""], ["f"],
+                          ["n"], [1])
+    with pytest.raises(ValueError, match="unserializable"):
+        eng.ingest_leaves([docs[0]], [1], [1], [0], ["root"], ["f"],
+                          ["n"], [set()])
+    for d in docs:
+        assert eng.deli.doc_seq(d) == seq_before[d]
+    # a clientSeq gap nacks just that op; the rest apply
+    res = eng.ingest_leaves([docs[0], docs[0], docs[1]], [1] * 3,
+                            [1, 99, 1], [0] * 3, ["root"] * 3,
+                            ["kids"] * 3, ["x0", "x1", "y0"],
+                            [0, 1, 2])
+    assert res["nacked"] == 1 and res["seq"][1] < 0
+    assert eng.has_node(docs[0], "x0")
+    assert not eng.has_node(docs[0], "x1")
+    assert eng.has_node(docs[1], "y0")
+
+
+def test_tree_leaves_bad_types_afters_values_rejected_pre_seq():
+    """Review r4: malformed types/afters/unsortable values must be
+    rejected BEFORE sequencing (a post-sequencing crash poisons)."""
+    eng, _, docs = _mk(R=2)
+    d = docs[0]
+    before = eng.deli.doc_seq(d)
+    with pytest.raises(ValueError, match="type"):
+        eng.ingest_leaves([d], [1], [1], [0], ["root"], ["f"], ["n"],
+                          [1], types=[["x"]])
+    with pytest.raises(ValueError, match="after"):
+        eng.ingest_leaves([d], [1], [1], [0], ["root"], ["f"], ["n"],
+                          [1], afters=[7])
+    with pytest.raises(ValueError, match="unserializable"):
+        eng.ingest_leaves([d], [1], [1], [0], ["root"], ["f"], ["n"],
+                          [{"a": 1, 2: 3}])   # unsortable mixed keys
+    assert eng.deli.doc_seq(d) == before
+    eng.summarize()  # not poisoned
