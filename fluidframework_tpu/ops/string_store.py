@@ -764,6 +764,13 @@ class TensorStringStore(StringOpInterner):
             if local_docs % nxt != 0:
                 break
             tile = nxt
+        if use_pallas and tile is not None \
+                and tile * self.capacity * 300 > 15_500_000:
+            # no smaller dividing tile fits the scoped-VMEM budget (odd
+            # doc factors, or large capacity even at T=8): an over-budget
+            # Pallas launch fails compilation on a real TPU — take the
+            # XLA scan path instead
+            use_pallas = False
         return use_pallas, (tile if tile is not None else 8), \
             (mode == "interpret")
 

@@ -436,22 +436,31 @@ class ServingEngineBase:
         survive recovery); OPs queue for the device merge. A
         ``control_hook(msg) -> True`` consumes engine-specific control
         records before they reach the stores."""
+        tail: List[SequencedDocumentMessage] = []
         for p in range(self.log.n_partitions):
             for rec in self.log.read(p,
                                      from_offset=summary["log_offsets"][p]):
-                msgs = rec.expand() if isinstance(rec, ColumnarOps) else (rec,)
-                for msg in msgs:
-                    self.deli.replay(msg)
-                    self._record_attribution(msg)
-                    if control_hook is not None and control_hook(msg):
-                        continue
-                    if msg.type == MessageType.OP:
-                        self._enqueue(msg.doc_id, msg)
-                        # max, not last-write: whole-batch columnar records
-                        # round-robin across partitions, so partition scan
-                        # order is not chronological
-                        self._min_seq[msg.doc_id] = max(
-                            self._min_seq.get(msg.doc_id, 0), msg.min_seq)
+                tail.extend(rec.expand() if isinstance(rec, ColumnarOps)
+                            else (rec,))
+        # Partition scan order is NOT chronological: whole-batch columnar
+        # records round-robin across partitions while JOIN/LEAVE stay in
+        # the doc's own partition. Replaying a client's ops before its
+        # JOIN would silently skip them in the sequencer and then let the
+        # JOIN replay reset ClientState to last_client_seq=0 — the
+        # client's next op is CLIENT_SEQ_GAP-nacked forever and resent
+        # old clientSeqs are re-accepted (dedupe broken). Sort the whole
+        # tail by (doc, seq) — seqs are per-doc, and JOIN/LEAVE carry
+        # theirs — so every doc replays in true chronological order.
+        tail.sort(key=lambda m: (m.doc_id, m.seq))
+        for msg in tail:
+            self.deli.replay(msg)
+            self._record_attribution(msg)
+            if control_hook is not None and control_hook(msg):
+                continue
+            if msg.type == MessageType.OP:
+                self._enqueue(msg.doc_id, msg)
+                self._min_seq[msg.doc_id] = max(
+                    self._min_seq.get(msg.doc_id, 0), msg.min_seq)
         self._queue.sort(key=lambda dm: dm[1].seq)
 
 
@@ -1769,6 +1778,12 @@ class MatrixServingEngine(ServingEngineBase):
         out_seq, out_min, nacked, n_ok = self._sequence_columnar(
             raw, self._row_handle[rows], client, cseq, ref, "cell batch")
         ok = np.flatnonzero(~nacked)
+        # the CLAMPED ref is what the log records and what recovery
+        # replays through _flush_impl — the live resolve perspective and
+        # FWW comparison must use the same value, or an inflated raw ref
+        # (> doc.seq, accepted-and-clamped by the sequencer) makes live
+        # and recovered state silently diverge
+        ref_clamped = self._clamped_ref(ref, out_seq)
 
         # one resolve-only axis scan for every accepted op
         per_axis: Dict[int, list] = {}
@@ -1781,11 +1796,11 @@ class MatrixServingEngine(ServingEngineBase):
             rl.append((int(OpKind.AXIS_RESOLVE), int(rpos[i]), 0, 0,
                        int(out_seq[i]),
                        self.axis_store.client(ar, int(client[i])),
-                       int(ref[i])))
+                       int(ref_clamped[i])))
             cl_.append((int(OpKind.AXIS_RESOLVE), int(cpos[i]), 0, 0,
                        int(out_seq[i]),
                        self.axis_store.client(ac, int(client[i])),
-                       int(ref[i])))
+                       int(ref_clamped[i])))
             slots.append((ar, len(rl) - 1, ac, len(cl_) - 1))
         records = []
         contents_tab = []
@@ -1808,7 +1823,8 @@ class MatrixServingEngine(ServingEngineBase):
                 cell = (rk, ck)
                 if self._fww[row]:
                     sq, writer = meta.get(cell, (0, None))
-                    if sq > int(ref[i]) and writer != int(client[i]):
+                    if sq > int(ref_clamped[i]) and \
+                            writer != int(client[i]):
                         continue
                 meta[cell] = (int(out_seq[i]), int(client[i]))
                 records.append(((row, rk), ck, values[i],
@@ -1820,7 +1836,6 @@ class MatrixServingEngine(ServingEngineBase):
         ts = self.deli.clock()
         id_tab = sorted(set(doc_ids))
         id_of = {d: i for i, d in enumerate(id_tab)}
-        ref_clamped = self._clamped_ref(ref, out_seq)
         self._append_columnar(ColumnarOps(
             id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
                                 count=len(ok)),

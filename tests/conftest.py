@@ -38,3 +38,39 @@ import pytest  # noqa: E402
 def _bound_compile_arena():
     yield
     jax.clear_caches()
+
+
+# Hard-exit machinery: full-suite runs have died in XLA's C++ teardown
+# (atexit destructors) AFTER every test passed, eating the terminal
+# summary and the exit status — CI could not prove the green run. The
+# latest safe point to bail is pytest_unconfigure: by then the terminal
+# reporter's sessionfinish wrapper has completed (failure recap,
+# warnings, --durations, the stats line are all printed); os._exit then
+# skips only the crashing interpreter teardown, preserving the status.
+_exit_status = [None]
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _exit_status[0] = int(exitstatus)
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_unconfigure(config):
+    import sys
+    # os._exit skips ALL buffered-stream flushing: flush every stream the
+    # terminal reporter may have written through (capture swaps sys.stdout,
+    # so the summary text can sit in the ORIGINAL stream's buffer)
+    try:
+        config.get_terminal_writer().flush()
+    except Exception:
+        pass
+    for f in (sys.stdout, sys.stderr, sys.__stdout__, sys.__stderr__):
+        try:
+            f.flush()
+        except Exception:
+            pass
+    # sessionfinish never ran (startup failure before the session): let
+    # pytest's own error exit code through rather than forging a 0
+    if _exit_status[0] is not None \
+            and not os.environ.get("FLUID_NO_HARDEXIT"):
+        os._exit(_exit_status[0])

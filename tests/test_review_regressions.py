@@ -370,3 +370,54 @@ def test_matrix_engine_nacks_cell_capacity_before_logging():
     engine2 = MatrixServingEngine.load(engine.summarize(), log)
     for i in acked:
         assert engine2.get_cell("m", i, 0) == f"v{i}", i
+
+
+def test_replay_tail_orders_join_before_columnar_ops():
+    """A client that joins after the base summary, whose columnar ops land
+    in an earlier-scanned partition than its JOIN (whole-batch records
+    round-robin; JOINs stay in the doc's partition), must survive recovery
+    with its sequencer state intact. Pre-fix, partition-scan replay fed the
+    ops before the JOIN: they were skipped (unknown client) and the late
+    JOIN reset ClientState to last_client_seq=0 — the next legitimate op
+    was CLIENT_SEQ_GAP-nacked forever and resent old clientSeqs were
+    re-accepted (dedupe broken)."""
+    import pytest
+
+    from fluidframework_tpu.server import native_deli
+    if not native_deli.available():
+        pytest.skip("native sequencer unavailable")
+    from fluidframework_tpu.ops.schema import OpKind
+    from fluidframework_tpu.server.deli import NackReason
+    from fluidframework_tpu.server.oplog import partition_of
+    from fluidframework_tpu.server.serving import StringServingEngine
+
+    eng = StringServingEngine(n_docs=4, capacity=256, batch_window=10 ** 9,
+                              sequencer="native", n_partitions=8)
+    # a doc whose own partition is scanned AFTER partition 0, where the
+    # first whole-batch columnar record lands
+    doc = next(f"doc-{i}" for i in range(64)
+               if partition_of(f"doc-{i}", 8) > 0)
+    eng.connect(doc, 1)
+    summary = eng.summarize()
+    eng.connect(doc, 2)  # joins AFTER the base summary
+    O = 4
+    rows = np.array([eng.doc_row(doc)], np.int32)
+    kind = np.full((1, O), int(OpKind.STR_INSERT), np.int32)
+    zeros = np.zeros((1, O), np.int32)
+    cseq = np.arange(1, O + 1, dtype=np.int32).reshape(1, O)
+    client = np.full((1, O), 2, np.int32)
+    res = eng.ingest_planes(rows, client, cseq, zeros, kind, zeros, zeros,
+                            "ab")
+    assert res["nacked"] == 0
+    want = eng.read_text(doc)
+
+    restored = StringServingEngine.load(summary, eng.log)
+    assert restored.read_text(doc) == want
+    # the client's next op is accepted: ClientState survived the replay
+    msg, nack = restored.submit(
+        doc, 2, O + 1, 0, {"mt": "insert", "kind": 0, "pos": 0, "text": "Z"})
+    assert nack is None and msg is not None
+    # and a resent old clientSeq is still deduped, not re-applied
+    _, nack = restored.submit(
+        doc, 2, 1, 0, {"mt": "insert", "kind": 0, "pos": 0, "text": "Z"})
+    assert nack is not None and nack.reason == NackReason.DUPLICATE
