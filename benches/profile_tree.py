@@ -1,0 +1,137 @@
+"""Profile the tree serving paths post-redesign: dict ingest_batch,
+pre-encoded ingest_records, flat ingest_leaves, kernel-only."""
+import time
+
+import numpy as np
+import jax
+
+from fluidframework_tpu.server.serving import TreeServingEngine
+from fluidframework_tpu.server.tree_wire import encode_tree_batch
+from fluidframework_tpu.ops.tree_kernel import TreeState
+
+n_docs = 8192
+eng = TreeServingEngine(n_docs=n_docs, capacity=128,
+                        batch_window=10 ** 9, sequencer="native")
+tdocs = [f"t-{i}" for i in range(n_docs)]
+for d in tdocs:
+    eng.connect(d, 1)
+
+
+def tree_ops(wave):
+    ids, ops = [], []
+    for d in tdocs:
+        ids.append(d)
+        if wave == 0:
+            ops.append({"op": "insert", "parent": "root",
+                        "field": "kids", "after": None,
+                        "nodes": [{"id": f"{d}-n0", "type": "item",
+                                   "value": 0}]})
+        else:
+            prev = f"{d}-n{wave - 1}"
+            ops.append({"op": "transaction",
+                        "constraints": [{"nodeExists": prev}],
+                        "edits": [
+                            {"op": "insert", "parent": "root",
+                             "field": "kids", "after": prev,
+                             "nodes": [{"id": f"{d}-n{wave}",
+                                        "type": "item",
+                                        "value": wave}]},
+                            {"op": "setValue", "id": prev,
+                             "value": wave * 10}]})
+    return ids, ops
+
+
+ones = [1] * n_docs
+
+# warmup (dict path compiles the dispatch too)
+ids, ops = tree_ops(0)
+t0 = time.perf_counter()
+eng.ingest_batch(ids, ones, ones, [0] * n_docs, ops)
+print(f"warmup wave (incl compile): {(time.perf_counter()-t0)*1000:.0f}ms")
+_ = np.asarray(eng.store.state.node_id)
+
+# dict path: one wave
+ids, ops = tree_ops(1)
+t0 = time.perf_counter()
+eng.ingest_batch(ids, ones, [2] * n_docs, [0] * n_docs, ops)
+t_host = time.perf_counter() - t0
+_ = np.asarray(eng.store.state.node_id)
+t_sync = time.perf_counter() - t0
+print(f"dict wave: host={t_host*1000:.1f}ms synced={t_sync*1000:.1f}ms "
+      f"-> {n_docs/t_sync:.0f} ops/s (host-bound {n_docs/t_host:.0f})")
+
+# pre-encoded path: encode outside the timed section (client work)
+ids, ops = tree_ops(2)
+t0 = time.perf_counter()
+batch = encode_tree_batch(ops)
+t_enc = time.perf_counter() - t0
+print(f"client encode: {t_enc*1000:.1f}ms ({t_enc/n_docs*1e6:.2f}us/op), "
+      f"recs={len(batch['rec_op'])}")
+
+t0 = time.perf_counter()
+eng.ingest_records(ids, ones, [3] * n_docs, [0] * n_docs, batch)
+t_host = time.perf_counter() - t0
+_ = np.asarray(eng.store.state.node_id)
+t_sync = time.perf_counter() - t0
+print(f"records wave: host={t_host*1000:.1f}ms synced={t_sync*1000:.1f}ms "
+      f"-> {n_docs/t_sync:.0f} ops/s (host-bound {n_docs/t_host:.0f})")
+snap = eng.metrics.snapshot()
+print({k: round(v, 1) for k, v in snap.items() if "ingest_" in k and
+       "p50" in k})
+
+# pipelined: 4 pre-encoded waves, one sync
+batches = []
+for w in range(4, 8):
+    ids, ops = tree_ops(w)
+    batches.append(encode_tree_batch(ops))
+t0 = time.perf_counter()
+for w, b in enumerate(batches):
+    eng.ingest_records(ids, ones, [w + 5] * n_docs, [0] * n_docs, b)
+_ = np.asarray(eng.store.state.node_id)
+t_pipe = time.perf_counter() - t0
+print(f"4 record waves pipelined: {t_pipe*1000:.1f}ms -> "
+      f"{4*n_docs/t_pipe:.0f} ops/s")
+
+# flat leaves path
+n_leaf = 8192
+leng = TreeServingEngine(n_docs=n_leaf, capacity=128,
+                         batch_window=10 ** 9, sequencer="native")
+ldocs = [f"f-{i}" for i in range(n_leaf)]
+for d in ldocs:
+    leng.connect(d, 1)
+lones = [1] * n_leaf
+leng.ingest_leaves(ldocs, lones, lones, [0] * n_leaf, ["root"] * n_leaf,
+                   ["kids"] * n_leaf, [f"{d}-f0" for d in ldocs],
+                   [0] * n_leaf)
+_ = np.asarray(leng.store.state.node_id)
+t0 = time.perf_counter()
+for wave in range(1, 5):
+    leng.ingest_leaves(ldocs, lones, [wave + 1] * n_leaf, [0] * n_leaf,
+                       ["root"] * n_leaf, ["kids"] * n_leaf,
+                       [f"{d}-f{wave}" for d in ldocs], [wave] * n_leaf,
+                       afters=[f"{d}-f{wave-1}" for d in ldocs])
+_ = np.asarray(leng.store.state.node_id)
+t_flat = time.perf_counter() - t0
+print(f"4 flat waves: {t_flat*1000:.1f}ms -> {4*n_leaf/t_flat:.0f} ops/s")
+
+# kernel-only: pre-packed planes, pipelined applies
+ids, ops = tree_ops(9)
+batch = encode_tree_batch(ops)
+rec_op = batch["rec_op"]
+g = eng._map_records(batch["recs"], batch)
+rows = np.arange(n_docs, dtype=np.int64)[rec_op]
+seqs = np.full(len(rec_op), 50, np.int64)
+planes = eng.store.pack_records(rows, g, seqs)
+import jax.numpy as jnp
+jp = jnp.asarray(planes)
+from fluidframework_tpu.ops.tree_kernel import apply_tree_planes_jit
+st = TreeState.create(n_docs, 128)
+st = apply_tree_planes_jit(st, jp)
+_ = np.asarray(st.overflow)
+t0 = time.perf_counter()
+for _i in range(8):
+    st = apply_tree_planes_jit(st, jp)
+_ = np.asarray(st.overflow)
+t_k = time.perf_counter() - t0
+print(f"kernel-only 8 applies (O={planes.shape[2]}): {t_k*1000:.1f}ms -> "
+      f"{8*n_docs/t_k:.0f} ops/s")

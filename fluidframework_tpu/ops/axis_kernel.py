@@ -143,10 +143,17 @@ class TensorAxisStore:
     Run identities intern (mixed opKey, key_offset) → int32 handles;
     per-axis-row client interning feeds the remover bitmask."""
 
-    def __init__(self, n_docs: int, capacity: int = 256):
+    def __init__(self, n_docs: int, capacity: int = 256, mesh=None):
+        """``mesh``: a 1-D ``docs`` device mesh shards the axis rows by
+        doc block (a doc's row+col axes stay on one chip); the axis scan
+        runs as a collective-free shard_map of the same kernel."""
         self.n_docs = n_docs
         self.capacity = capacity
+        self.mesh = mesh
         self.state = StringState.create(2 * n_docs, capacity, n_props=1)
+        if mesh is not None:
+            from ..parallel.sharded import shard_axis_store_state
+            self.state = shard_axis_store_state(self.state, mesh)
         self._runs: List[Tuple[int, int]] = [(0, 0)]  # run 0 reserved
         self._run_ids: Dict[Tuple[int, int], int] = {}
         self._client_idx: List[Dict[int, int]] = [
@@ -177,8 +184,9 @@ class TensorAxisStore:
         skips the sequential scan entirely (pure vmap — see
         ``resolve_axis_positions``)."""
         kind = np.asarray(planes["kind"])
-        if np.isin(kind, (int(OpKind.AXIS_RESOLVE),
-                          int(OpKind.NOOP))).all():
+        if self.mesh is None and np.isin(
+                kind, (int(OpKind.AXIS_RESOLVE),
+                       int(OpKind.NOOP))).all():
             rh, ro = resolve_axis_positions(
                 self.state, jnp.asarray(planes["a0"]),
                 jnp.asarray(planes["client"]),
@@ -186,16 +194,54 @@ class TensorAxisStore:
             is_res = kind == int(OpKind.AXIS_RESOLVE)
             return (np.where(is_res, np.asarray(rh), -1),
                     np.where(is_res, np.asarray(ro), -1))
+        if self.mesh is not None:
+            from ..parallel.sharded import sharded_axis_apply
+            self.state, rh, ro = sharded_axis_apply(self.mesh)(
+                self.state,
+                tuple(jnp.asarray(planes[k]) for k in
+                      ("kind", "a0", "a1", "a2", "seq", "client",
+                       "ref_seq")))
+            return np.asarray(rh), np.asarray(ro)
         self.state, rh, ro = apply_axis_batch_jit(
             self.state,
             *(jnp.asarray(planes[k]) for k in
               ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")))
         return np.asarray(rh), np.asarray(ro)
 
+    def resolve_async(self, planes: dict):
+        """Mutation-free position resolves returned as DEVICE arrays,
+        with the host copy started asynchronously — the caller harvests
+        them later, so the ingest path never blocks on a device round
+        trip (the matrix engine's resolve pipelining)."""
+        if self.mesh is not None:
+            from ..parallel.sharded import sharded_axis_apply
+            st, rh, ro = sharded_axis_apply(self.mesh)(
+                self.state,
+                tuple(jnp.asarray(planes[k]) for k in
+                      ("kind", "a0", "a1", "a2", "seq", "client",
+                       "ref_seq")))
+            self.state = st   # resolve-only: content unchanged
+        else:
+            rh, ro = resolve_axis_positions(
+                self.state, jnp.asarray(planes["a0"]),
+                jnp.asarray(planes["client"]),
+                jnp.asarray(planes["ref_seq"]))
+        for x in (rh, ro):
+            try:
+                x.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+        return rh, ro
+
     def visible_lengths(self) -> np.ndarray:
         return np.asarray(axis_visible_lengths(self.state))
 
     def compact(self, min_seq: np.ndarray) -> None:
+        if self.mesh is not None:
+            from ..parallel.sharded import sharded_compact
+            self.state = sharded_compact(self.mesh, with_props=False)(
+                self.state, jnp.asarray(min_seq))
+            return
         from .merge_tree_kernel import compact_string_state_jit
         self.state = compact_string_state_jit(
             self.state, jnp.asarray(min_seq), with_props=False)
@@ -218,11 +264,73 @@ class TensorAxisStore:
             "client_idx": [dict(m) for m in self._client_idx],
         }
 
+    def snapshot_rows(self, axis_rows, runs_base: int) -> dict:
+        """Incremental snapshot of the given AXIS rows (2 per dirty doc):
+        one fused device gather, plus the append-only run-table delta
+        since ``runs_base``; clean axis rows ride by reference to the
+        base summary."""
+        from .schema import pad_rows_pow2
+        from .string_store import _gather_rows_jit
+        rows = np.ascontiguousarray(axis_rows, np.int32)
+        if len(rows):
+            rows_p, _p2, n = pad_rows_pow2(rows)
+            g = [np.asarray(x)[:n] for x in
+                 _gather_rows_jit(self.state, jnp.asarray(rows_p))]
+            w = max(int(g[8].max()), 1)
+            planes = {k: g[i][:, :w].copy()
+                      for i, k in enumerate(_PLANES)}
+            counts, overflow = g[8].copy(), g[9].copy()
+        else:
+            planes = {k: np.zeros((0, 1), np.int32) for k in _PLANES}
+            counts = overflow = np.zeros((0,), np.int32)
+        return {
+            "rows": rows, "planes": planes, "count": counts,
+            "overflow": overflow,
+            "runs_delta": [list(r) for r in self._runs[runs_base:]],
+            "client_idx": {int(r): dict(self._client_idx[int(r)])
+                           for r in rows},
+        }
+
+    def apply_row_snapshot(self, delta: dict) -> None:
+        """Fold one ``snapshot_rows`` delta into this (restored-base)
+        store: extend the run table, replace the rows' client maps,
+        overwrite the rows' planes in one scatter."""
+        from .string_store import _write_rows_jit
+        for r in delta["runs_delta"]:
+            k = (int(r[0]), int(r[1]))
+            self._run_ids[k] = len(self._runs)
+            self._runs.append(k)
+        rows = np.asarray(delta["rows"], np.int32)
+        if not len(rows):
+            return
+        for r, m in delta["client_idx"].items():
+            self._client_idx[int(r)] = {int(c): v for c, v in m.items()}
+        from .schema import bucket_rows, pad_rows_pow2
+        w = delta["planes"]["seq"].shape[1]
+        rows_p, p2, n = pad_rows_pow2(rows)
+
+        def bucket(a):
+            return jnp.asarray(bucket_rows(a, p2, n))
+
+        def pad(k):
+            fill = NOT_REMOVED if k == "removed_seq" else 0
+            out = np.full((p2, self.capacity), fill, np.int32)
+            out[:n, :w] = delta["planes"][k]
+            out[n:] = out[:1]
+            return jnp.asarray(out)
+
+        prop = jnp.zeros((p2, self.capacity, 1), jnp.int32)
+        self.state = _write_rows_jit(
+            self.state, jnp.asarray(rows_p),
+            *(pad(k) for k in _PLANES), prop,
+            bucket(delta["count"]), bucket(delta["overflow"]))
+
     @classmethod
-    def restore(cls, snap: dict) -> "TensorAxisStore":
+    def restore(cls, snap: dict, mesh=None) -> "TensorAxisStore":
         store = cls.__new__(cls)
         store.n_docs = snap["count"].shape[0] // 2
         store.capacity = snap["capacity"]
+        store.mesh = mesh
         cap = snap["capacity"]
         full = {}
         for k in _PLANES:
@@ -236,6 +344,9 @@ class TensorAxisStore:
             prop_val=jnp.zeros((snap["count"].shape[0], cap, 1), jnp.int32),
             count=jnp.asarray(snap["count"]),
             overflow=jnp.asarray(snap["overflow"]))
+        if mesh is not None:
+            from ..parallel.sharded import shard_axis_store_state
+            store.state = shard_axis_store_state(store.state, mesh)
         store._runs = [tuple(r) for r in snap["runs"]]
         store._run_ids = {r: i for i, r in enumerate(store._runs) if i}
         store._client_idx = [dict(m) for m in snap["client_idx"]]

@@ -101,6 +101,21 @@ def apply_map_batch(state: MapState, kind: jax.Array, a0: jax.Array,
 apply_map_batch_jit = jax.jit(apply_map_batch, donate_argnums=0)
 
 
+@jax.jit
+def _gather_map_rows_jit(state: "MapState", rows):
+    """Fused device gather of selected doc rows (incremental summary)."""
+    return (state.present[rows], state.value[rows], state.last_seq[rows])
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _write_map_rows_jit(state: "MapState", rows, present, value, last_seq):
+    """Overwrite selected doc rows (delta restore; duplicate padding rows
+    scatter identical values — a no-op)."""
+    return MapState(present=state.present.at[rows].set(present),
+                    value=state.value.at[rows].set(value),
+                    last_seq=state.last_seq.at[rows].set(last_seq))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("R", "O", "n_docs", "scatter_rows",
                                     "wide_vals"))
@@ -276,6 +291,53 @@ class TensorMapStore:
             "key_ids": [dict(m) for m in self._key_ids],
             "values": self._interner.export(),
         }
+
+    def snapshot_rows(self, rows, values_base: int) -> dict:
+        """Incremental snapshot: only the given doc rows' planes (one
+        fused device→host gather) plus the append-only value-interner
+        DELTA since the base summary (``values_base`` = its table
+        length). Clean rows ride by reference to the base (SURVEY.md
+        §2.16 handle reuse)."""
+        from .schema import pad_rows_pow2
+        rows = np.ascontiguousarray(rows, np.int32)
+        if len(rows):
+            rows_p, _p2, n = pad_rows_pow2(rows)
+            g = _gather_map_rows_jit(self.state, jnp.asarray(rows_p))
+            present, value, last_seq = (np.asarray(x)[:n].copy()
+                                        for x in g)
+        else:
+            present = value = last_seq = np.zeros((0, self.n_keys),
+                                                  np.int32)
+        return {
+            "rows": rows,
+            "present": present, "value": value, "last_seq": last_seq,
+            "key_ids": {int(r): dict(self._key_ids[int(r)])
+                        for r in rows},
+            "values_delta": self._interner.export_from(values_base),
+        }
+
+    def apply_row_snapshot(self, delta: dict) -> None:
+        """Fold one ``snapshot_rows`` delta into this (restored-base)
+        store: overwrite the dirty rows' planes in one scatter, extend
+        the append-only value table, replace the rows' key maps."""
+        self._interner.extend_from(delta["values_delta"])
+        rows = np.asarray(delta["rows"], np.int32)
+        if not len(rows):
+            return
+        from .schema import bucket_rows, pad_rows_pow2
+        for r, m in delta["key_ids"].items():
+            self._key_ids[int(r)] = dict(m)
+        rows_p, p2, n = pad_rows_pow2(rows)
+
+        def bucket(a):
+            return jnp.asarray(bucket_rows(a, p2, n))
+
+        self.state = _write_map_rows_jit(
+            self.state, jnp.asarray(rows_p), bucket(delta["present"]),
+            bucket(delta["value"]), bucket(delta["last_seq"]))
+        if self.mesh is not None:
+            from ..parallel.sharded import shard_map_store_state
+            self.state = shard_map_store_state(self.state, self.mesh)
 
     @classmethod
     def restore(cls, snap: dict, mesh=None) -> "TensorMapStore":

@@ -135,6 +135,10 @@ class TensorMatrixStore:
     def value_handle(self, value) -> int:
         return self._interner.handle(value)
 
+    def conservative_room(self, extra: int) -> bool:
+        """Can ``extra`` more distinct identities still fit the table?"""
+        return len(self._cell_ids) + extra < self.capacity
+
     def switch_set_cell_policy(self) -> None:
         """One-way LWW → FWW switch (reference ``switchSetCellPolicy``)."""
         self.fww = True
@@ -199,6 +203,50 @@ class TensorMatrixStore:
             "fww": self.fww,
         }
 
+    def table_bases(self) -> dict:
+        """Append-only table lengths (incremental-summary baselines)."""
+        return {"cell_ids": len(self._cell_ids),
+                "values": len(self._interner)}
+
+    def snapshot_delta(self, bases: dict) -> dict:
+        """Incremental snapshot: the live-trimmed cell planes (the table
+        is key-sorted and globally re-sorted every merge, so cell deltas
+        are whole-pool — bounded by LIVE CELLS, not history) plus the
+        append-only identity/value table deltas since ``bases``."""
+        import itertools
+        n = max(int(np.asarray(self.state.count)), 0)
+        return {
+            "key": np.asarray(self.state.key)[:n].copy(),
+            "seq": np.asarray(self.state.seq)[:n].copy(),
+            "value": np.asarray(self.state.value)[:n].copy(),
+            "count": n,
+            "overflow": int(np.asarray(self.state.overflow)),
+            "fww": self.fww,
+            "cell_ids_delta": list(itertools.islice(
+                self._cell_ids.items(), bases["cell_ids"], None)),
+            "values_delta": self._interner.export_from(bases["values"]),
+        }
+
+    def apply_delta(self, delta: dict) -> None:
+        """Fold one ``snapshot_delta`` into this (restored-base) store:
+        replace the cell planes, extend the append-only tables."""
+        n = delta["count"]
+        key = np.full((self.capacity,), EMPTY_KEY, np.int32)
+        seq = np.zeros((self.capacity,), np.int32)
+        val = np.zeros((self.capacity,), np.int32)
+        key[:n] = delta["key"]
+        seq[:n] = delta["seq"]
+        val[:n] = delta["value"]
+        self.state = MatrixCellState(
+            key=jnp.asarray(key), seq=jnp.asarray(seq),
+            value=jnp.asarray(val),
+            count=jnp.asarray(n, jnp.int32),
+            overflow=jnp.asarray(delta["overflow"], jnp.int32))
+        for k, v in delta["cell_ids_delta"]:
+            self._cell_ids[tuple_key(k)] = v
+        self._interner.extend_from(delta["values_delta"])
+        self.fww = delta["fww"]
+
     @classmethod
     def restore(cls, snap: dict) -> "TensorMatrixStore":
         store = cls.__new__(cls)
@@ -220,3 +268,207 @@ def tuple_key(k):
     turned nested tuples into lists)."""
     return tuple(tuple_key(x) if isinstance(x, (list, tuple)) else x
                  for x in k)
+
+
+class ShardedMatrixStore:
+    """Doc-sharded cell pools (mesh mode): shard ``s`` owns the cells of
+    doc rows ``[s·D/S, (s+1)·D/S)``. Cells are doc-scoped — the doc row
+    is the first component of every cell identity ``((row, rowKey),
+    colKey)`` — so routing by owning doc keeps the sort-merge entirely
+    shard-local: the sharded apply is a collective-free shard_map of the
+    same ``apply_cells_batch`` (SURVEY.md §2.14 doc-DP for the matrix
+    cell volume). Same host API as ``TensorMatrixStore``."""
+
+    def __init__(self, capacity: int, mesh, n_docs: int,
+                 batch_size: int = 4096):
+        s = mesh.devices.size
+        if capacity % s:
+            raise ValueError(f"cell capacity {capacity} not divisible by "
+                             f"mesh size {s}")
+        if n_docs % s:
+            raise ValueError(f"n_docs {n_docs} not divisible by mesh "
+                             f"size {s}")
+        self.capacity = capacity          # total across shards
+        self.shard_capacity = capacity // s
+        self.n_shards = s
+        self.n_docs = n_docs
+        self.mesh = mesh
+        self.batch = batch_size
+        self.state = MatrixCellState(
+            key=jnp.full((s, self.shard_capacity), EMPTY_KEY, jnp.int32),
+            seq=jnp.zeros((s, self.shard_capacity), jnp.int32),
+            value=jnp.zeros((s, self.shard_capacity), jnp.int32),
+            count=jnp.zeros((s,), jnp.int32),
+            overflow=jnp.zeros((s,), jnp.int32))
+        self._place()
+        self._cell_ids: Dict[Tuple, int] = {}
+        self._shard_counts = [0] * s     # interned identities per shard
+        self._interner = ValueInterner()
+        self.fww = False
+
+    def _place(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import DOC_AXIS
+        row = NamedSharding(self.mesh, P(DOC_AXIS, None))
+        one = NamedSharding(self.mesh, P(DOC_AXIS))
+        self.state = MatrixCellState(
+            key=jax.device_put(self.state.key, row),
+            seq=jax.device_put(self.state.seq, row),
+            value=jax.device_put(self.state.value, row),
+            count=jax.device_put(self.state.count, one),
+            overflow=jax.device_put(self.state.overflow, one))
+
+    def shard_of_row(self, row: int) -> int:
+        return row * self.n_shards // self.n_docs
+
+    def cell_id(self, row_key, col_key) -> int:
+        k = (row_key, col_key)
+        if k not in self._cell_ids:
+            self._cell_ids[k] = len(self._cell_ids)
+            self._shard_counts[self.shard_of_row(row_key[0])] += 1
+        return self._cell_ids[k]
+
+    def value_handle(self, value) -> int:
+        return self._interner.handle(value)
+
+    def conservative_room(self, extra: int) -> bool:
+        """Worst case: every pending cell mints on the fullest shard."""
+        return max(self._shard_counts) + extra < self.shard_capacity
+
+    def switch_set_cell_policy(self) -> None:
+        self.fww = True
+
+    def apply_batch(self, records) -> None:
+        """records: iterable of (row_key, col_key, value, seq), seq
+        ascending; row_key = (doc_row, resolved key) — the doc row routes
+        the write to its owning shard's pool."""
+        per_shard: List[list] = [[] for _ in range(self.n_shards)]
+        for r, c, v, q in records:
+            per_shard[self.shard_of_row(r[0])].append(
+                (self.cell_id(r, c), int(q), self.value_handle(v)))
+        widest = max((len(p) for p in per_shard), default=0)
+        if not widest:
+            return
+        for base in range(0, widest, self.batch):
+            o = min(self.batch, widest - base)
+            o2 = 8
+            while o2 < o:
+                o2 *= 2
+            key = np.full((self.n_shards, o2), EMPTY_KEY, np.int32)
+            seq = np.zeros((self.n_shards, o2), np.int32)
+            val = np.zeros((self.n_shards, o2), np.int32)
+            for s, recs in enumerate(per_shard):
+                chunk = recs[base:base + self.batch]
+                if not chunk:
+                    continue
+                arr = np.array(chunk, np.int32)
+                key[s, :len(chunk)] = arr[:, 0]
+                seq[s, :len(chunk)] = arr[:, 1]
+                val[s, :len(chunk)] = arr[:, 2]
+            from ..parallel.sharded import sharded_cells_apply
+            self.state = sharded_cells_apply(self.mesh, self.fww)(
+                self.state, jnp.asarray(key), jnp.asarray(seq),
+                jnp.asarray(val))
+
+    def read_cell(self, cell: Tuple):
+        cid = self._cell_ids.get(cell)
+        if cid is None:
+            return None
+        s = self.shard_of_row(cell[0][0])
+        keys = self.state.key[s]
+        idx = int(jnp.searchsorted(keys, jnp.int32(cid)))
+        if idx >= self.shard_capacity or int(keys[idx]) != cid:
+            return None
+        return self._interner.value(int(self.state.value[s, idx]))
+
+    def read_cells(self) -> dict:
+        keys = np.asarray(self.state.key).reshape(-1)
+        vals = np.asarray(self.state.value).reshape(-1)
+        live = keys != EMPTY_KEY
+        by_id = {int(k): int(v) for k, v in zip(keys[live], vals[live])}
+        return {cell: self._interner.value(by_id[cid])
+                for cell, cid in self._cell_ids.items() if cid in by_id}
+
+    def overflowed(self) -> bool:
+        return bool(np.asarray(self.state.overflow).any())
+
+    # ----------------------------------------------------- snapshot / resume
+
+    def snapshot(self) -> dict:
+        return {
+            "key": np.asarray(self.state.key).copy(),
+            "seq": np.asarray(self.state.seq).copy(),
+            "value": np.asarray(self.state.value).copy(),
+            "count": np.asarray(self.state.count).copy(),
+            "overflow": np.asarray(self.state.overflow).copy(),
+            "batch": self.batch,
+            "cell_ids": list(self._cell_ids.items()),
+            "values": self._interner.export(),
+            "fww": self.fww,
+            "sharded_docs": self.n_docs,
+        }
+
+    @classmethod
+    def restore(cls, snap: dict, mesh) -> "ShardedMatrixStore":
+        s, t_s = snap["key"].shape
+        store = cls(s * t_s, mesh, snap["sharded_docs"],
+                    batch_size=snap["batch"])
+        store.state = MatrixCellState(
+            key=jnp.asarray(snap["key"]), seq=jnp.asarray(snap["seq"]),
+            value=jnp.asarray(snap["value"]),
+            count=jnp.asarray(snap["count"], jnp.int32),
+            overflow=jnp.asarray(snap["overflow"], jnp.int32))
+        store._place()
+        for k, v in snap["cell_ids"]:
+            ck = tuple_key(k)
+            store._cell_ids[ck] = v
+            store._shard_counts[store.shard_of_row(ck[0][0])] += 1
+        store._interner = ValueInterner.restore(snap["values"])
+        store.fww = snap["fww"]
+        return store
+
+    def table_bases(self) -> dict:
+        return {"cell_ids": len(self._cell_ids),
+                "values": len(self._interner)}
+
+    def snapshot_delta(self, bases: dict) -> dict:
+        """Per-shard live-trimmed planes + append-only table deltas (same
+        contract as TensorMatrixStore.snapshot_delta)."""
+        import itertools
+        counts = np.asarray(self.state.count)
+        w = max(int(counts.max()), 1)
+        return {
+            "key": np.asarray(self.state.key)[:, :w].copy(),
+            "seq": np.asarray(self.state.seq)[:, :w].copy(),
+            "value": np.asarray(self.state.value)[:, :w].copy(),
+            "count": counts.copy(),
+            "overflow": np.asarray(self.state.overflow).copy(),
+            "fww": self.fww,
+            "cell_ids_delta": list(itertools.islice(
+                self._cell_ids.items(), bases["cell_ids"], None)),
+            "values_delta": self._interner.export_from(bases["values"]),
+        }
+
+    def apply_delta(self, delta: dict) -> None:
+        w = delta["key"].shape[1]
+        key = np.full((self.n_shards, self.shard_capacity), EMPTY_KEY,
+                      np.int32)
+        seq = np.zeros((self.n_shards, self.shard_capacity), np.int32)
+        val = np.zeros((self.n_shards, self.shard_capacity), np.int32)
+        key[:, :w] = delta["key"]
+        seq[:, :w] = delta["seq"]
+        val[:, :w] = delta["value"]
+        self.state = MatrixCellState(
+            key=jnp.asarray(key), seq=jnp.asarray(seq),
+            value=jnp.asarray(val),
+            count=jnp.asarray(np.asarray(delta["count"], np.int32)),
+            overflow=jnp.asarray(np.asarray(delta["overflow"],
+                                            np.int32)))
+        self._place()
+        for k, v in delta["cell_ids_delta"]:
+            ck = tuple_key(k)
+            if ck not in self._cell_ids:
+                self._shard_counts[self.shard_of_row(ck[0][0])] += 1
+            self._cell_ids[ck] = v
+        self._interner.extend_from(delta["values_delta"])
+        self.fww = delta["fww"]

@@ -112,6 +112,31 @@ class OpBatch:
         return OpBatch(*(np.ascontiguousarray(arr[:, i]) for i in range(N_OP_FIELDS)))
 
 
+def pad_rows_pow2(rows):
+    """Pow2-pad a dirty-row list for the incremental-summary gather/
+    scatter jits (one compiled program per BUCKET, not per distinct row
+    count). Padding repeats row 0 — a duplicate gather is discarded, a
+    duplicate scatter writes identical values (a no-op). Returns
+    (rows_padded, p2, n)."""
+    import numpy as np
+    rows = np.ascontiguousarray(rows, np.int32)
+    n = len(rows)
+    p2 = 1 << (n - 1).bit_length() if n else 1
+    if p2 > n:
+        rows = np.concatenate([rows, np.full(p2 - n, rows[0], np.int32)])
+    return rows, p2, n
+
+
+def bucket_rows(a, p2: int, n: int):
+    """Pad a per-row array to the pow2 bucket by repeating row 0's
+    entry (the scatter-side counterpart of ``pad_rows_pow2``)."""
+    import numpy as np
+    a = np.asarray(a, np.int32)
+    if p2 > n:
+        a = np.concatenate([a, np.repeat(a[:1], p2 - n, axis=0)])
+    return a
+
+
 class ValueInterner:
     """JSON value ↔ int32 handle interning shared by the device stores
     (map/matrix): handle 0 is reserved for "no value"; equal values (by
@@ -127,6 +152,24 @@ class ValueInterner:
             self._ids[enc] = len(self._values)
             self._values.append(value)
         return self._ids[enc]
+
+    def bulk(self, items) -> list:
+        """Handles for a whole value table at once (columnar ingest)."""
+        ids = self._ids
+        values = self._values
+        get = ids.get
+        dumps = json.dumps
+        out = []
+        append = out.append
+        for v in items:
+            enc = dumps(v, sort_keys=True)
+            h = get(enc)
+            if h is None:
+                h = len(values)
+                ids[enc] = h
+                values.append(v)
+            append(h)
+        return out
 
     def value(self, handle: int):
         return self._values[handle]

@@ -951,6 +951,64 @@ class TensorStringStore(StringOpInterner):
         heapq.heapify(tombs)
         self._iv_tombs[doc] = tombs
 
+    def add_intervals_bulk(self, spans: Dict[int, list]
+                           ) -> Dict[int, List[str]]:
+        """Anchor many intervals across many docs with ONE fused device
+        gather: ``spans`` maps doc row → [(start, end, props)].
+        ``add_interval`` pays ≥2 device round trips per call (tomb seed +
+        anchor pulls) — ruinous over a tunnel link for mass setup (e.g.
+        loading an annotated corpus); this path pulls every target row's
+        read planes in one dispatch and anchors host-side."""
+        rows = np.asarray(sorted(spans), np.int32)
+        if not len(rows):
+            return {}
+        n = len(rows)
+        p2 = 1 << (n - 1).bit_length()
+        rows_p = np.concatenate([rows, np.full(p2 - n, rows[0],
+                                               np.int32)])
+        g = [np.asarray(x)[:n] for x in
+             _gather_rows_jit(self.state, jnp.asarray(rows_p))]
+        self.device_reads = getattr(self, "device_reads", 0) + 1
+        removed_g, length_g = g[2], g[4]
+        hop_g, hoff_g, count_g = g[5], g[6], g[8]
+        out: Dict[int, List[str]] = {}
+        for j, row in enumerate(map(int, rows)):
+            cnt = int(count_g[j])
+            removed = removed_g[j, :cnt]
+            hop, hoff = hop_g[j, :cnt], hoff_g[j, :cnt]
+            length = length_g[j, :cnt]
+            live = removed == NOT_REMOVED
+            if not self._intervals[row]:
+                # seed tombs from the pulled planes (no extra read)
+                floor = self._iv_min_seq[row]
+                tombs = [int(s) for s in removed[removed != NOT_REMOVED]
+                         if s > floor]
+                heapq.heapify(tombs)
+                self._iv_tombs[row] = tombs
+
+            def anchor(pos: int):
+                at = 0
+                last = None
+                for i in range(cnt):
+                    if not live[i]:
+                        continue
+                    if at <= pos < at + length[i]:
+                        return (int(hop[i]), int(hoff[i]) + (pos - at))
+                    at += int(length[i])
+                    last = (int(hop[i]),
+                            int(hoff[i]) + int(length[i]) - 1)
+                return last
+
+            ids = []
+            for start, end, props in spans[row]:
+                self._interval_counter += 1
+                iid = f"iv{self._interval_counter}"
+                self._intervals[row][iid] = (anchor(start), anchor(end),
+                                             dict(props or {}))
+                ids.append(iid)
+            out[row] = ids
+        return out
+
     def add_interval(self, doc: int, start: int, end: int,
                      props: Optional[dict] = None) -> str:
         if not self._intervals[doc]:

@@ -55,6 +55,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -71,6 +72,20 @@ class TreeOpKind(enum.IntEnum):
     REMOVE = 6            # node
     MOVE = 7              # node,parent,after,field
     SET_VALUE = 8         # node,value
+    # "solo" kinds: a COMPLETE one-record op — same math as the base kind
+    # (solo − 4) but ignoring the group flags (a standalone edit's implicit
+    # TXN_BEGIN reset would make ok == 1 anyway). They exist so the volume
+    # paths (flat inserts, standalone removes/sets) cost ONE scan step per
+    # op instead of a begin/guard preamble. Never valid inside a
+    # transaction group (they would bypass its constraint gate).
+    INSERT_SOLO = 9
+    REMOVE_SOLO = 10
+    MOVE_SOLO = 11
+    SET_SOLO = 12
+    # fused TXN_BEGIN + TXN_GUARD_EXISTS(node): resets both flags, then
+    # ok_txn &= exists — the first constraint of every transaction rides
+    # its begin record (one record less on the wire per transaction)
+    TXN_BEGIN_EXISTS = 13
 
 
 META_NESTED = 1
@@ -259,10 +274,60 @@ def _apply_set_value(s, node, value, ok):
 
 # ------------------------------------------------------------- batched apply
 
+def _one_record(c, k, solo, nd, pa, af, fi, va, ty, sq, me, *, structural):
+    """Apply one record to one doc's planes. ``k`` is the BASE kind (solo
+    already folded); ``structural`` statically includes the remove/move
+    subtree math — the batch step gates it behind a column-level cond so
+    insert/set-heavy batches never pay the (N×N) subtree walks."""
+    s = {key: c[key] for key in _TREE_PLANES}
+    begin = (k == TreeOpKind.TXN_BEGIN) | \
+        (k == TreeOpKind.TXN_BEGIN_EXISTS)
+    ok_ins = jnp.where((k == TreeOpKind.INS_BEGIN) | begin, 1, c["ok_ins"])
+    ok_txn = jnp.where(begin, 1, c["ok_txn"])
+    ok_ins = jnp.where(
+        k == TreeOpKind.INS_GUARD_ABSENT,
+        ok_ins & ~_exists(s, nd), ok_ins)
+    ok_txn = jnp.where(
+        (k == TreeOpKind.TXN_GUARD_EXISTS) |
+        (k == TreeOpKind.TXN_BEGIN_EXISTS),
+        ok_txn & _exists(s, nd), ok_txn)
+    ok = (ok_ins & ok_txn).astype(bool) | solo
+
+    ins, would_ovf = _apply_insert(
+        s, nd, pa, af, fi, va, ty, sq, (me & META_NESTED) != 0,
+        ok & (k == TreeOpKind.INSERT))
+    sv = _apply_set_value(s, nd, va, ok & (k == TreeOpKind.SET_VALUE))
+    if structural:
+        rem = _apply_remove(s, nd, ok & (k == TreeOpKind.REMOVE))
+        mov = _apply_move(s, nd, pa, af, fi, ok & (k == TreeOpKind.MOVE))
+
+    out = {}
+    for key in _TREE_PLANES:
+        v = jnp.where(
+            k == TreeOpKind.INSERT, ins[key],
+            jnp.where(k == TreeOpKind.SET_VALUE, sv[key], s[key]))
+        if structural:
+            v = jnp.where(
+                k == TreeOpKind.REMOVE, rem[key],
+                jnp.where(k == TreeOpKind.MOVE, mov[key], v))
+        out[key] = v
+    out["overflow"] = jnp.where(
+        (k == TreeOpKind.INSERT) & would_ovf, 1, c["overflow"])
+    out["ok_ins"] = ok_ins
+    out["ok_txn"] = ok_txn
+    return out
+
+
 def apply_tree_batch(state: TreeState, kind, node, parent, after, field,
                      value, type_, seq, meta) -> TreeState:
     """Apply a dense (D, O) batch of expanded tree records, per-doc in
-    column order (the sequencer's total order); NOOP pads skip."""
+    column order (the sequencer's total order); NOOP pads skip.
+
+    Per record column the step dispatches one of three bodies via
+    ``lax.cond``: all-NOOP columns (pow2 padding) are identity, columns
+    with any remove/move run the full structural body, and everything
+    else runs the light body (no subtree-mask while loops) — the batch
+    only pays for the op classes it actually contains."""
     sd = {k: getattr(state, k) for k in _TREE_PLANES}
     sd["overflow"] = state.overflow
     sd["ok_ins"] = jnp.ones_like(state.overflow)
@@ -270,45 +335,24 @@ def apply_tree_batch(state: TreeState, kind, node, parent, after, field,
 
     def step(carry, op):
         k, nd, pa, af, fi, va, ty, sq, me = op
+        solo = (k >= TreeOpKind.INSERT_SOLO) & (k <= TreeOpKind.SET_SOLO)
+        base = jnp.where(solo, k - 4, k)
+        heavy = jnp.any((base == TreeOpKind.REMOVE) |
+                        (base == TreeOpKind.MOVE))
+        any_op = jnp.any(k != TreeOpKind.NOOP)
 
-        def one(c, k, nd, pa, af, fi, va, ty, sq, me):
-            s = {key: c[key] for key in _TREE_PLANES}
-            ok_ins = jnp.where(
-                (k == TreeOpKind.INS_BEGIN) | (k == TreeOpKind.TXN_BEGIN),
-                1, c["ok_ins"])
-            ok_txn = jnp.where(k == TreeOpKind.TXN_BEGIN, 1, c["ok_txn"])
-            ok_ins = jnp.where(
-                k == TreeOpKind.INS_GUARD_ABSENT,
-                ok_ins & ~_exists(s, nd), ok_ins)
-            ok_txn = jnp.where(
-                k == TreeOpKind.TXN_GUARD_EXISTS,
-                ok_txn & _exists(s, nd), ok_txn)
-            ok = (ok_ins & ok_txn).astype(bool)
+        def run(structural):
+            def go(c):
+                return jax.vmap(functools.partial(
+                    _one_record, structural=structural))(
+                        c, base, solo, nd, pa, af, fi, va, ty, sq, me)
+            return go
 
-            ins, would_ovf = _apply_insert(
-                s, nd, pa, af, fi, va, ty, sq, (me & META_NESTED) != 0,
-                ok & (k == TreeOpKind.INSERT))
-            rem = _apply_remove(s, nd, ok & (k == TreeOpKind.REMOVE))
-            mov = _apply_move(s, nd, pa, af, fi,
-                              ok & (k == TreeOpKind.MOVE))
-            sv = _apply_set_value(s, nd, va,
-                                  ok & (k == TreeOpKind.SET_VALUE))
-
-            out = {}
-            for key in _TREE_PLANES:
-                out[key] = jnp.where(
-                    k == TreeOpKind.INSERT, ins[key],
-                    jnp.where(k == TreeOpKind.REMOVE, rem[key],
-                              jnp.where(k == TreeOpKind.MOVE, mov[key],
-                                        jnp.where(k == TreeOpKind.SET_VALUE,
-                                                  sv[key], s[key]))))
-            out["overflow"] = jnp.where(
-                (k == TreeOpKind.INSERT) & would_ovf, 1, c["overflow"])
-            out["ok_ins"] = ok_ins
-            out["ok_txn"] = ok_txn
-            return out
-
-        return jax.vmap(one)(carry, k, nd, pa, af, fi, va, ty, sq, me), None
+        out = jax.lax.cond(
+            heavy, run(True),
+            lambda c: jax.lax.cond(any_op, run(False), lambda c2: c2, c),
+            carry)
+        return out, None
 
     ops = tuple(x.T for x in (kind, node, parent, after, field, value,
                               type_, seq, meta))
@@ -318,6 +362,82 @@ def apply_tree_batch(state: TreeState, kind, node, parent, after, field,
 
 
 apply_tree_batch_jit = jax.jit(apply_tree_batch, donate_argnums=0)
+
+
+def apply_tree_planes(state: TreeState, planes) -> TreeState:
+    """Stacked-plane entry: ``planes`` is ONE (9, D, O) int32 buffer
+    (kind, node, parent, after, field, value, type_, meta, seq) — a single
+    contiguous host→device transfer per batch instead of nine."""
+    return apply_tree_batch(
+        state, planes[0], planes[1], planes[2], planes[3], planes[4],
+        planes[5], planes[6], planes[8], planes[7])
+
+
+apply_tree_planes_jit = jax.jit(apply_tree_planes, donate_argnums=0)
+
+
+def apply_tree_wire(state: TreeState, cols, ids, vals, row, pos, base,
+                    id_map, f_map, t_map, v_map, *, o: int) -> TreeState:
+    """Compact-wire apply: width-coded record columns + batch-local table
+    maps, expanded ON DEVICE (map gathers, dense scatter, per-record seq
+    derivation). The host→device upload is the serving bottleneck (the
+    tunnel/PCIe link), so the wire ships ~a dozen bytes per record — the
+    tree analog of the string path's width-coded wire profiles.
+
+    - ``cols`` (R, 3) u8: kind | meta<<4 (meta bit 0 = nested, bit 1 =
+      first-record-of-op), field_local, type_local
+    - ``ids`` (R, 3) u16: node/parent/after batch-local 1-based indices
+    - ``vals`` (R,) u16: value batch-local index
+    - ``row`` (R,) u16 / ``pos`` (R,) u8 or u16: dense scatter
+      coordinates; ``pos == o`` (out of range) drops the record (R is
+      pow2-padded)
+    - ``base`` (D,) i32: each doc's FIRST op seq this batch (per-doc op
+      seqs are consecutive within a batch, so per-record seq = base +
+      running count of first-of-op bits − 1)
+    - ``*_map`` i32: batch-local index → global interner handle
+    """
+    i32 = jnp.int32
+    kind = (cols[:, 0] & 0xF).astype(i32)
+    meta = (cols[:, 0] >> 4).astype(i32)
+    field = f_map[cols[:, 1].astype(i32)]
+    type_ = t_map[cols[:, 2].astype(i32)]
+    node = id_map[ids[:, 0].astype(i32)]
+    parent = id_map[ids[:, 1].astype(i32)]
+    after = id_map[ids[:, 2].astype(i32)]
+    value = v_map[vals.astype(i32)]
+    d = state.node_id.shape[0]
+    r, p = row.astype(i32), pos.astype(i32)
+    stacked = jnp.stack([kind, node, parent, after, field, value, type_,
+                         meta & 1], axis=0)              # (8, R)
+    dense = jnp.zeros((8, d, o), i32).at[:, r, p].set(stacked,
+                                                      mode="drop")
+    first = jnp.zeros((d, o), i32).at[r, p].set((meta >> 1) & 1,
+                                                mode="drop")
+    seq = base[:, None] + jnp.cumsum(first, axis=1) - 1
+    return apply_tree_batch(state, dense[0], dense[1], dense[2], dense[3],
+                            dense[4], dense[5], dense[6], seq, dense[7])
+
+
+apply_tree_wire_jit = jax.jit(apply_tree_wire, donate_argnums=0,
+                              static_argnames=("o",))
+
+
+@jax.jit
+def gather_tree_rows_jit(state: TreeState, rows):
+    """Fused device gather of selected doc rows (incremental summary)."""
+    return tuple(getattr(state, k)[rows] for k in _TREE_PLANES) + \
+        (state.overflow[rows],)
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def write_tree_rows_jit(state: TreeState, rows, *planes_and_overflow):
+    """Overwrite selected doc rows (delta restore; duplicate padding
+    rows scatter identical values — a no-op)."""
+    updates = {k: getattr(state, k).at[rows].set(planes_and_overflow[i])
+               for i, k in enumerate(_TREE_PLANES)}
+    return TreeState(**updates,
+                     overflow=state.overflow.at[rows].set(
+                         planes_and_overflow[-1]))
 
 
 def tree_state_digest(state: TreeState) -> jax.Array:

@@ -163,6 +163,109 @@ def sharded_map_merge(mesh: Mesh):
     return _CACHE[key]
 
 
+def tree_state_specs():
+    """PartitionSpecs of every TreeState plane on a docs-only mesh."""
+    from ..ops.tree_kernel import TreeState
+    row = P(DOC_AXIS, None)
+    return TreeState(node_id=row, parent=row, field=row, value=row,
+                     type_=row, prev_sib=row, next_sib=row,
+                     created_seq=row, overflow=P(DOC_AXIS))
+
+
+def shard_tree_store_state(state, mesh: Mesh):
+    """Place a tree store's planes onto the mesh, doc-row sharded."""
+    if state.node_id.shape[0] % mesh.devices.size != 0:
+        raise ValueError(f"n_docs {state.node_id.shape[0]} not divisible "
+                         f"by mesh size {mesh.devices.size}")
+    return jax.tree.map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+        state, tree_state_specs())
+
+
+def sharded_tree_apply(mesh: Mesh):
+    """The doc-sharded packed-plane tree apply: shard_map of the SAME
+    single-chip record scan over each shard's doc block (tree merge is
+    per-doc math — collective-free by construction)."""
+    key = ("tree_apply", mesh)
+    if key not in _CACHE:
+        from ..ops.tree_kernel import apply_tree_planes
+        specs = tree_state_specs()
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def fn(state, planes):
+            return jax.shard_map(
+                apply_tree_planes, mesh=mesh,
+                in_specs=(specs, P(None, DOC_AXIS, None)),
+                out_specs=specs, check_vma=False)(state, planes)
+        _CACHE[key] = fn
+    return _CACHE[key]
+
+
+def axis_state_specs():
+    """PartitionSpecs of the matrix AXIS store's StringState (2 axis rows
+    per doc, adjacent, so doc-block sharding keeps a doc's row+col axes
+    on one chip; shard blocks are even by construction)."""
+    return doc_state_specs()
+
+
+def shard_axis_store_state(state: StringState, mesh: Mesh) -> StringState:
+    n_rows = state.seq.shape[0]
+    if n_rows % (2 * mesh.devices.size) != 0:
+        raise ValueError(f"axis rows {n_rows} not divisible by "
+                         f"2×mesh size {2 * mesh.devices.size}")
+    return shard_store_state(state, mesh)
+
+
+def sharded_axis_apply(mesh: Mesh):
+    """The doc-sharded axis scan (mutations + in-scan position
+    resolves): shard_map of apply_axis_batch over each shard's axis-row
+    block; resolve outputs come back row-sharded."""
+    key = ("axis_apply", mesh)
+    if key not in _CACHE:
+        from ..ops.axis_kernel import apply_axis_batch
+        specs = axis_state_specs()
+        row = P(DOC_AXIS, None)
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def fn(state, planes):
+            return jax.shard_map(
+                apply_axis_batch, mesh=mesh,
+                in_specs=(specs,) + (row,) * 7,
+                out_specs=(specs, row, row), check_vma=False)(
+                    state, *planes)
+        _CACHE[key] = fn
+    return _CACHE[key]
+
+
+def sharded_cells_apply(mesh: Mesh, fww: bool):
+    """The doc-sharded cell merge: each shard owns the cell POOL SLICE of
+    its doc block (cells are doc-scoped, so routing by owning doc keeps
+    the sort-merge shard-local — collective-free)."""
+    key = ("cells_apply", mesh, fww)
+    if key not in _CACHE:
+        from ..ops.matrix_kernel import apply_cells_batch
+
+        @functools.partial(jax.jit, donate_argnums=0)
+        def fn(state, key_p, seq_p, val_p):
+            def body(st, k, s, v):
+                return jax.vmap(
+                    functools.partial(apply_cells_batch, fww=fww))(
+                        st, k, s, v)
+            from ..ops.matrix_kernel import MatrixCellState
+            specs = MatrixCellState(
+                key=P(DOC_AXIS, None), seq=P(DOC_AXIS, None),
+                value=P(DOC_AXIS, None), count=P(DOC_AXIS),
+                overflow=P(DOC_AXIS))
+            return jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(specs, P(DOC_AXIS, None), P(DOC_AXIS, None),
+                          P(DOC_AXIS, None)),
+                out_specs=specs, check_vma=False)(
+                    state, key_p, seq_p, val_p)
+        _CACHE[key] = fn
+    return _CACHE[key]
+
+
 def assert_collective_free(mesh: Mesh, n_docs: int, capacity: int,
                            n_ops: int) -> str:
     """Compile the sharded merge at the given shape and prove the apply

@@ -80,6 +80,15 @@ class ContainerRuntime:
         self.datastores: Dict[str, FluidDataStoreRuntime] = {}
         self._pending_ds_summaries: Dict[str, dict] = {}
         self._deferred_stash: List[dict] = []
+        # channel-handle reuse baselines: per-channel seqs captured at the
+        # last summarize() (promoted on ack) — see summarize(incremental=)
+        self._capture_channel_seqs: Optional[Dict[str, Dict[str, int]]] \
+            = None
+        self._acked_channel_seqs: Optional[Dict[str, Dict[str, int]]] \
+            = None
+        # (ds_id, channel_id) → outbound datastore refs at the channel's
+        # last FULL serialization (GC marking for handle-reuse nodes)
+        self._channel_refs: Dict[tuple, list] = {}
         self.root_datastores: set = set()
         self.gc = GarbageCollector(
             sweep_grace_summaries=self.options.gc_sweep_grace_summaries,
@@ -333,22 +342,69 @@ class ContainerRuntime:
 
     # ---------------------------------------------------------------- summary
 
-    def summarize(self, run_gc: bool = True) -> dict:
+    def summarize(self, run_gc: bool = True,
+                  incremental: bool = False) -> dict:
         """Runtime summary subtree (§3.4): every datastore, realized or not,
         plus document-global id-compressor and GC state. With ``run_gc``,
         the mark/sweep pass prunes swept datastores from the summary AND
         from this replica (other replicas drop them when they next load —
         the GC-op coordination of the reference is collapsed into the
-        summary itself)."""
-        datastores = {ds_id: ds.summarize()
+        summary itself).
+
+        ``incremental=True`` (meaningful after ``on_summary_ack``):
+        channels that processed no op since the last ACKED summary emit
+        ``__handle__`` nodes instead of their full subtree; the storage
+        service materializes them against the prior summary at upload
+        (SURVEY.md §2.16). GC still marks correctly: each channel's
+        outbound references are cached when it serializes in full, and
+        handle nodes contribute their cached refs to the mark phase."""
+        from .gc import collect_handles, fluid_handle
+        prev = self._acked_channel_seqs if incremental else None
+        datastores = {ds_id: ds.summarize(prev.get(ds_id)
+                                          if prev is not None else None)
                       for ds_id, ds in self.datastores.items()}
         datastores.update(self._pending_ds_summaries)
+        # capture the per-channel baselines this summary represents; they
+        # become the handle-reuse baseline when the summary is ACKED
+        self._capture_channel_seqs = {
+            ds_id: ds.channel_seqs()
+            for ds_id, ds in self.datastores.items()}
+        if self.gc.enabled:
+            # refresh the per-channel ref cache from EVERY fully
+            # serialized channel regardless of run_gc — a later
+            # incremental summary's handle nodes mark via these refs,
+            # and a run_gc=False serialization must not leave the cache
+            # stale (a handle channel marking with empty refs would let
+            # GC sweep a datastore it still references)
+            for ds_id, ds in datastores.items():
+                for cid, ch in (ds.get("channels") or {}).items():
+                    if not (isinstance(ch, dict) and "__handle__" in ch):
+                        self._channel_refs[(ds_id, cid)] = sorted(
+                            collect_handles(ch))
         if run_gc and self.gc.enabled:
+            # handle nodes contribute their cached refs to the mark view
+            gc_view: Dict[str, dict] = {}
+            for ds_id, ds in datastores.items():
+                chans = ds.get("channels") or {}
+                view_ch = {}
+                for cid, ch in chans.items():
+                    if isinstance(ch, dict) and "__handle__" in ch:
+                        refs = self._channel_refs.get((ds_id, cid), ())
+                        view_ch[cid] = {"refs": [fluid_handle(r)
+                                                 for r in refs]}
+                    else:
+                        view_ch[cid] = ch
+                gc_view[ds_id] = dict(ds, channels=view_ch)
             swept_before = len(self.gc.swept)
-            datastores = self.gc.run(datastores, set(self.root_datastores))
+            kept = self.gc.run(gc_view, set(self.root_datastores))
+            datastores = {ds_id: s for ds_id, s in datastores.items()
+                          if ds_id in kept}
             for ds_id in self.gc.swept[swept_before:]:
                 self.datastores.pop(ds_id, None)
                 self._pending_ds_summaries.pop(ds_id, None)
+                for key in [k for k in self._channel_refs
+                            if k[0] == ds_id]:
+                    del self._channel_refs[key]   # keep the cache bounded
         out = {"datastores": datastores,
                "roots": sorted(self.root_datastores)}
         if self.gc.enabled:
@@ -356,6 +412,25 @@ class ContainerRuntime:
         if self.id_compressor is not None:
             out["idCompressor"] = self.id_compressor.summarize()
         return out
+
+    def take_summary_capture(self):
+        """The per-channel seqs captured by the LAST ``summarize()`` call
+        — the summarizer snapshots this right after building its upload,
+        so an out-of-band ``summarize()`` between upload and ack cannot
+        poison the promoted baseline."""
+        cap, self._capture_channel_seqs = self._capture_channel_seqs, None
+        return cap
+
+    def on_summary_ack(self, capture=None) -> None:
+        """The summarizer's proposal was ACKED: promote the captured
+        per-channel seqs to the handle-reuse baseline (unchanged channels
+        may now reference the acked summary by handle). ``capture`` is
+        the snapshot the summarizer took at UPLOAD time (see
+        ``take_summary_capture``)."""
+        if capture is None:
+            capture = self._capture_channel_seqs
+        if capture is not None:
+            self._acked_channel_seqs = capture
 
     def _load_summary(self, summary: dict) -> None:
         self._pending_ds_summaries = dict(summary.get("datastores", {}))

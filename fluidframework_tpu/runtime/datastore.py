@@ -116,18 +116,39 @@ class FluidDataStoreRuntime:
 
     # -------------------------------------------------------------- summaries
 
-    def summarize(self) -> dict:
+    def summarize(self, prev_channel_seqs: Optional[Dict[str, int]] = None
+                  ) -> dict:
         """Summary subtree: one entry per channel (realized channels
         summarize live; unrealized ones pass their loaded summary through —
-        reference: summarizer handle reuse for unchanged subtrees)."""
+        reference: summarizer handle reuse for unchanged subtrees).
+
+        ``prev_channel_seqs`` ({channel id → baseSeq at the last ACKED
+        summary}) enables channel-handle reuse: a channel that processed
+        no op since then emits a ``__handle__`` node referencing its
+        subtree in the prior summary instead of re-serializing — the
+        storage service materializes it at upload (SURVEY.md §2.16:
+        incremental via handle reuse)."""
         # baseSeq records each channel's capture point (reference: the
         # .attributes sequence number) so realization restores the base
         # perspective; unrealized passthrough summaries keep their original
-        channels = {cid: dict(ch.summarize(),
-                              baseSeq=ch.last_processed_seq)
-                    for cid, ch in self._channels.items()}
+        channels = {}
+        for cid, ch in self._channels.items():
+            base = ch.last_processed_seq
+            if prev_channel_seqs is not None \
+                    and prev_channel_seqs.get(cid) == base:
+                # structural (ds, channel) path: ids may contain any
+                # character, so no string splitting at resolution
+                channels[cid] = {
+                    "__handle__": [self.id, cid], "baseSeq": base}
+            else:
+                channels[cid] = dict(ch.summarize(), baseSeq=base)
         channels.update(self._pending_summaries)
         return {"channels": channels}
+
+    def channel_seqs(self) -> Dict[str, int]:
+        """{channel id → last processed seq} (handle-reuse baselines)."""
+        return {cid: ch.last_processed_seq
+                for cid, ch in self._channels.items()}
 
     @classmethod
     def load(cls, ds_id: str, registry: ChannelRegistry, client_id: int,

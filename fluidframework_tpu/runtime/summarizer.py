@@ -45,6 +45,9 @@ class SummaryConfig:
     min_ops: int = 1              # never summarize with fewer new ops
     max_time_s: float = 60.0      # time since last ack that forces a summary
     max_attempts: int = 3         # consecutive nacks before giving up
+    #: channel-handle reuse: unchanged channels upload a handle node
+    #: referencing the last ACKED summary (storage materializes it)
+    incremental: bool = True
 
 
 class SummaryManager:
@@ -63,6 +66,7 @@ class SummaryManager:
         self.last_ack_seq = container.base_seq
         self.last_ack_time = self.clock()
         self._in_flight = False
+        self._inflight_capture = None   # channel seqs of the upload
         self.pending_proposal: Optional[int] = None  # seq of our SUMMARIZE op
         self.failed_attempts = 0
         self.summaries_acked = 0
@@ -109,6 +113,13 @@ class SummaryManager:
                 self.pending_proposal = None
                 self.failed_attempts = 0
                 self.summaries_acked += 1
+                # unchanged channels may now reference this summary by
+                # handle (channel-handle reuse, SURVEY.md §2.16); the
+                # baseline is the capture taken at UPLOAD time, immune
+                # to out-of-band summarize() calls in between
+                self.container.runtime.on_summary_ack(
+                    self._inflight_capture)
+                self._inflight_capture = None
             return
         if msg.type == MessageType.SUMMARY_NACK:
             if self._in_flight \
@@ -154,8 +165,12 @@ class SummaryManager:
         seq = container.protocol.seq
         summary = {
             "protocol": container.protocol.snapshot(),
-            "runtime": container.runtime.summarize(),
+            # incremental is a no-op until the first ack establishes the
+            # handle-reuse baseline (summarize falls back to full)
+            "runtime": container.runtime.summarize(
+                incremental=self.config.incremental),
         }
+        self._inflight_capture = container.runtime.take_summary_capture()
         handle = container.service.summary_storage.upload_summary(
             summary, seq)
         # mark in-flight BEFORE submit: the synchronous local pipeline

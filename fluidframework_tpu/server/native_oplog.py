@@ -65,6 +65,11 @@ def _is_columnar(record: Any) -> bool:
     return isinstance(record, ColumnarOps)
 
 
+def _is_tree_records(record: Any) -> bool:
+    from .serving import TreeRecordOps  # lazy: serving does not import us
+    return isinstance(record, TreeRecordOps)
+
+
 # ------------------------------------------------------------------- codec
 # Fixed header (little-endian): client_id, client_seq, ref_seq, seq,
 # min_seq as int64, type as int32, doc_id length as int32, service
@@ -174,6 +179,72 @@ def decode_columnar(data: bytes, widths: bool = True):
                        keys=keys, values=values, **planes)
 
 
+# Tree record batch (tag b"T"): n_ops + n_recs + timestamp + one JSON
+# tables blob (doc ids + the 1-based id/field/type/value wire tables),
+# then width-coded per-op planes (doc, client, client_seq, ref_seq, seq,
+# min_seq), the rec_op plane, and the 8 record columns — every plane at
+# its smallest signed width, like the v3 columnar frame.
+_TREE_HEADER = struct.Struct("<qqdq")
+_TREE_OP_FIELDS = ("doc", "client", "client_seq", "ref_seq", "seq",
+                   "min_seq")
+
+
+def _encode_plane(plane, n: int) -> bytes:
+    import numpy as np
+    plane = np.asarray(plane)
+    assert plane.shape == (n,), "plane length mismatch"
+    w = _plane_width(plane)
+    return bytes([w]) + np.ascontiguousarray(
+        plane, dtype=f"<i{w}").tobytes()
+
+
+def _decode_plane(data: bytes, off: int, n: int):
+    import numpy as np
+    w = data[off]
+    arr = np.frombuffer(data, dtype=f"<i{w}", count=n,
+                        offset=off + 1).astype(np.int64)
+    return arr, off + 1 + w * n
+
+
+def encode_tree_records(rec) -> bytes:
+    n, r = len(rec.seq), len(rec.rec_op)
+    tables = json.dumps({"doc_ids": rec.doc_ids, "ids": rec.ids,
+                         "fields": rec.fields, "types": rec.types,
+                         "values": rec.values}).encode()
+    parts = [_TREE_HEADER.pack(n, r, float(rec.timestamp), len(tables)),
+             tables]
+    for f in _TREE_OP_FIELDS:
+        parts.append(_encode_plane(getattr(rec, f), n))
+    parts.append(_encode_plane(rec.rec_op, r))
+    for col in range(8):
+        parts.append(_encode_plane(rec.recs[:, col], r))
+    return b"".join(parts)
+
+
+def decode_tree_records(data: bytes):
+    import numpy as np
+    from .serving import TreeRecordOps  # lazy: serving does not import us
+    n, r, ts, tlen = _TREE_HEADER.unpack_from(data)
+    off = _TREE_HEADER.size
+    tables = json.loads(data[off:off + tlen])
+    off += tlen
+    planes = {}
+    for f in _TREE_OP_FIELDS:
+        planes[f], off = _decode_plane(data, off, n)
+    rec_op, off = _decode_plane(data, off, r)
+    cols = []
+    for _c in range(8):
+        col, off = _decode_plane(data, off, r)
+        cols.append(col.astype(np.int32))
+    recs = (np.stack(cols, axis=1) if r
+            else np.zeros((0, 8), np.int32))
+    return TreeRecordOps(
+        doc_ids=tables["doc_ids"], ids=tables["ids"],
+        fields=tables["fields"], types=tables["types"],
+        values=tables["values"], rec_op=rec_op, recs=recs,
+        timestamp=ts, **planes)
+
+
 def encode_message(msg: SequencedDocumentMessage) -> bytes:
     doc = msg.doc_id.encode()
     contents = json.dumps(
@@ -243,6 +314,8 @@ class NativePartitionedLog:
             tag, data = b"N", encode_message(record)
         elif _is_columnar(record):
             tag, data = b"D", encode_columnar(record)
+        elif _is_tree_records(record):
+            tag, data = b"T", encode_tree_records(record)
         else:
             # STRICT json — a silently-lossy str() fallback here would
             # corrupt recovery (oplog._spill_json's docstring names the
@@ -291,6 +364,8 @@ class NativePartitionedLog:
             return decode_message(raw[1:], header=_HEADER_V1)
         if raw[:1] == b"D":
             return decode_columnar(raw[1:])
+        if raw[:1] == b"T":
+            return decode_tree_records(raw[1:])
         if raw[:1] == b"C":  # legacy all-int64 columnar frame
             return decode_columnar(raw[1:], widths=False)
         return json.loads(raw[1:])

@@ -76,12 +76,60 @@ class Historian:
         self._lock = threading.Lock()
 
     def upload_summary(self, doc_id: str, summary: dict, seq: int) -> str:
+        """Store a summary; ``__handle__`` channel nodes (channel-handle
+        reuse — the client uploaded a reference instead of the subtree)
+        are materialized here against the doc's latest accepted summary,
+        so stored summaries are always fully resolved (the reference's
+        uploadSummaryWithContext handle semantics)."""
+        summary = self._resolve_handles(doc_id, summary)
         blob = json.dumps(summary, sort_keys=True, default=str).encode()
         sha = hashlib.sha1(blob).hexdigest()
         with self._lock:
             self._blobs[sha] = blob
             self._refs[doc_id] = (sha, seq)
         return sha
+
+    def _resolve_handles(self, doc_id: str, summary: dict) -> dict:
+        datastores = (summary.get("runtime") or {}).get("datastores")
+        if not datastores:
+            return summary
+        has_handle = any(
+            isinstance(ch, dict) and "__handle__" in ch
+            for ds in datastores.values()
+            for ch in (ds.get("channels") or {}).values())
+        if not has_handle:
+            return summary
+        prev, _seq, _sha = self.latest_summary(doc_id)
+        if prev is None:
+            raise ValueError(
+                f"{doc_id}: summary references a prior summary by handle "
+                "but none is stored")
+        prev_ds = (prev.get("runtime") or {}).get("datastores") or {}
+        out = dict(summary)
+        out["runtime"] = dict(summary["runtime"])
+        out_ds = out["runtime"]["datastores"] = {}
+        for ds_id, ds in datastores.items():
+            chans = ds.get("channels") or {}
+            if not any(isinstance(ch, dict) and "__handle__" in ch
+                       for ch in chans.values()):
+                out_ds[ds_id] = ds
+                continue
+            new_ds = dict(ds)
+            new_ch = new_ds["channels"] = {}
+            for cid, ch in chans.items():
+                if isinstance(ch, dict) and "__handle__" in ch:
+                    p_ds, p_cid = ch["__handle__"]
+                    try:
+                        new_ch[cid] = \
+                            prev_ds[p_ds]["channels"][p_cid]
+                    except KeyError:
+                        raise ValueError(
+                            f"{doc_id}: handle {p_ds}/{p_cid} not "
+                            "present in the prior summary") from None
+                else:
+                    new_ch[cid] = ch
+            out_ds[ds_id] = new_ds
+        return out
 
     def latest_summary(self, doc_id: str
                        ) -> Tuple[Optional[dict], int, Optional[str]]:

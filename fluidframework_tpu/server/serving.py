@@ -35,6 +35,7 @@ from ..utils.telemetry import MetricsCollector
 from ..ops.map_kernel import TensorMapStore
 from ..ops.schema import OpKind
 from ..ops.string_store import TensorStringStore
+from ..ops.tree_kernel import TreeOpKind
 from .deli import DeliSequencer, Nack, NackReason
 from .oplog import PartitionedLog, partition_of
 
@@ -130,8 +131,11 @@ class ColumnarOps:
             elif k == OpKind.STR_INSERT:
                 text = self.text if self.texts is None \
                     else self.texts[int(self.tidx[i])]
+                # clientSeq rides in the contents too: the ORACLE's
+                # remote-insert path keys payload handles by it
                 contents = {"mt": "insert", "kind": 0, "pos": int(self.a0[i]),
-                            "text": text}
+                            "text": text,
+                            "clientSeq": int(self.client_seq[i])}
             elif k == OpKind.STR_ANNOTATE:
                 contents = {"mt": "annotate", "start": int(self.a0[i]),
                             "end": int(self.a1[i]),
@@ -139,6 +143,65 @@ class ColumnarOps:
             else:
                 contents = {"mt": "remove", "start": int(self.a0[i]),
                             "end": int(self.a1[i])}
+            out.append(SequencedDocumentMessage(
+                doc_id=self.doc_ids[int(self.doc[i])],
+                client_id=int(self.client[i]),
+                client_seq=int(self.client_seq[i]),
+                ref_seq=int(self.ref_seq[i]), seq=int(self.seq[i]),
+                min_seq=int(self.min_seq[i]), type=MessageType.OP,
+                contents=contents, timestamp=self.timestamp))
+        return out
+
+
+@dataclasses.dataclass
+class TreeRecordOps:
+    """A columnar run of sequenced TREE ops in the durable log: per-op
+    sequencing planes plus the RAW kernel record planes and their
+    batch-local string/value tables (``server.tree_wire`` documents the
+    wire format). Recovery replays the record planes bit-identically
+    through the same kernel — no decode on the state path; ``expand``
+    decodes op dicts only for audit and oracle replay."""
+
+    doc_ids: List[str]          # row-local doc-id table
+    doc: np.ndarray             # (N,) index into doc_ids
+    client: np.ndarray          # (N,)
+    client_seq: np.ndarray      # (N,)
+    ref_seq: np.ndarray         # (N,)
+    seq: np.ndarray             # (N,)
+    min_seq: np.ndarray         # (N,)
+    rec_op: np.ndarray          # (R,) op index per record, ascending
+    recs: np.ndarray            # (R, 8) kind,node,parent,after,field,
+    #                             value,type_,meta (batch-LOCAL handles)
+    ids: List[str]              # 1-based tables (handle h ↔ table[h-1])
+    fields: List[str]
+    types: List[str]
+    values: list
+    timestamp: float = 0.0
+
+    def _op_slices(self):
+        """(start, end) record-range per op (rec_op is ascending)."""
+        n = len(self.seq)
+        starts = np.searchsorted(self.rec_op, np.arange(n), side="left")
+        ends = np.searchsorted(self.rec_op, np.arange(n), side="right")
+        return starts, ends
+
+    def expand(self, only_doc: Optional[str] = None):
+        """Per-op messages with DECODED dict contents (oracle replay /
+        audit; the recovery state path uses the raw planes instead)."""
+        from .tree_wire import decode_op
+        idxs = range(len(self.seq))
+        if only_doc is not None:
+            if only_doc not in self.doc_ids:
+                return []
+            want = self.doc_ids.index(only_doc)
+            idxs = np.flatnonzero(np.asarray(self.doc) == want)
+        starts, ends = self._op_slices()
+        out = []
+        for i in idxs:
+            recs = [tuple(int(v) for v in r)
+                    for r in self.recs[starts[i]:ends[i]]]
+            contents = decode_op(recs, self.ids, self.fields, self.types,
+                                 self.values)
             out.append(SequencedDocumentMessage(
                 doc_id=self.doc_ids[int(self.doc[i])],
                 client_id=int(self.client[i]),
@@ -185,6 +248,64 @@ class ServingEngineBase:
         # and summary refuses until the engine is rebuilt via load() —
         # summarizing now would durably persist never-logged ops
         self._poisoned: Optional[str] = None
+        # ---- incremental-summary machinery (shared by every engine) ----
+        # last summary + its dirty-detection baselines (doc seqs, row map,
+        # interner table lengths — engine-specific extras)
+        self._summ_bookkeeping: Optional[dict] = None
+        # docs whose device state was rewritten OUTSIDE the op stream
+        # (overflow re-upload, adoption): doc seq does not move, so
+        # seq-based dirty detection would miss them
+        self._dirty_outside_ops: set = set()
+        # bound the delta chain: past this depth summarize(incremental=
+        # True) produces a full summary instead (load()'s work and the
+        # retained base references stay bounded)
+        self.max_incremental_chain = 8
+        self._chain_depth = 0
+
+    # ------------------------------------------------ incremental summaries
+    # The engine-agnostic dirty-row detection behind summarize(
+    # incremental=True) (SURVEY.md §2.16 handle reuse): a row is dirty
+    # when its doc sequenced an op since the last summary (host-side, no
+    # device read), when its doc↔row mapping changed (graduation, row
+    # reuse), or when its device state was rewritten outside the op
+    # stream (_dirty_outside_ops). Engines call _dirty_rows_since +
+    # _note_summary and store per-store deltas; load() resolves the
+    # delta chain via resolve_summary_chain.
+
+    def _incremental_ok(self, incremental: bool) -> bool:
+        return (incremental and self._summ_bookkeeping is not None
+                and self._chain_depth < self.max_incremental_chain)
+
+    def _dirty_rows_since(self, prev: dict):
+        """(dirty row set, current doc seqs) vs the previous summary."""
+        cur_seqs = {d: self.deli.doc_seq(d) for d in self._doc_rows}
+        dirty = {row for d, row in self._doc_rows.items()
+                 if cur_seqs[d] != prev["doc_seqs"].get(d)}
+        # rows whose mapping changed since the base: their planes may
+        # have been cleared or adopted outside the op stream
+        dirty |= {row for d, row in prev["row_of"].items()
+                  if self._doc_rows.get(d) != row}
+        dirty |= {self._doc_rows[d] for d in self._dirty_outside_ops
+                  if d in self._doc_rows}
+        return dirty, cur_seqs
+
+    def _note_summary(self, summary: dict, cur_seqs: dict,
+                      **extra) -> None:
+        self._dirty_outside_ops.clear()
+        self._summ_bookkeeping = {
+            "summary": summary, "doc_seqs": cur_seqs,
+            "row_of": dict(self._doc_rows), **extra}
+
+    @staticmethod
+    def resolve_summary_chain(summary: dict):
+        """(newest full summary, deltas oldest→newest) of an incremental
+        chain (identity for a full summary)."""
+        chain: List[dict] = []
+        full = summary
+        while full.get("kind") == "delta":
+            chain.append(full)
+            full = full["base"]
+        return full, chain[::-1]
 
     def _check_poisoned(self) -> None:
         if self._poisoned:
@@ -440,7 +561,10 @@ class ServingEngineBase:
         for p in range(self.log.n_partitions):
             for rec in self.log.read(p,
                                      from_offset=summary["log_offsets"][p]):
-                tail.extend(rec.expand() if isinstance(rec, ColumnarOps)
+                # columnar batches (ColumnarOps, TreeRecordOps) expand to
+                # per-op messages; engines with a raw-record fast path
+                # override _replay_tail instead
+                tail.extend(rec.expand() if hasattr(rec, "expand")
                             else (rec,))
         # Partition scan order is NOT chronological: whole-batch columnar
         # records round-robin across partitions while JOIN/LEAVE stay in
@@ -492,18 +616,6 @@ class StringServingEngine(ServingEngineBase):
         # in-flight async overflow-flag copy (deferred harvest; see
         # ingest_planes' compact-due branch)
         self._ov_pending = None
-        # last summary + the dirty-detection baselines for incremental
-        # summaries (doc seqs / row map / interner table lengths)
-        self._summ_bookkeeping: Optional[dict] = None
-        # docs whose device planes were rewritten OUTSIDE the op stream
-        # (overflow re-upload): doc seq does not move, so seq-based dirty
-        # detection would miss them
-        self._dirty_outside_ops: set = set()
-        # bound the delta chain: past this depth summarize(incremental=
-        # True) produces a full summary instead (load()'s work and the
-        # retained base references stay bounded)
-        self.max_incremental_chain = 8
-        self._chain_depth = 0
         # mega tier: documents too long for one chip's slot budget are
         # served by the segment-axis-sharded store (declare with mark_mega
         # BEFORE the doc's first op; capacity here is per shard per doc)
@@ -1173,19 +1285,8 @@ class StringServingEngine(ServingEngineBase):
         self.flush()
         self.compact()
         prev = self._summ_bookkeeping
-        if incremental and prev is not None \
-                and self._chain_depth < self.max_incremental_chain:
-            cur_seqs = {d: self.deli.doc_seq(d) for d in self._doc_rows}
-            dirty_rows = {row for d, row in self._doc_rows.items()
-                          if cur_seqs[d] != prev["doc_seqs"].get(d)}
-            # rows whose mapping changed since the base: their planes may
-            # have been cleared or adopted outside the op stream
-            dirty_rows |= {row for d, row in prev["row_of"].items()
-                          if self._doc_rows.get(d) != row}
-            # rows rewritten in place (overflow re-upload): no seq delta
-            dirty_rows |= {self._doc_rows[d]
-                           for d in self._dirty_outside_ops
-                           if d in self._doc_rows}
+        if self._incremental_ok(incremental):
+            dirty_rows, cur_seqs = self._dirty_rows_since(prev)
             summary = self._base_summary()
             summary["kind"] = "delta"
             summary["base"] = prev["summary"]
@@ -1211,14 +1312,9 @@ class StringServingEngine(ServingEngineBase):
             summary["graduated"] = {d: s.snapshot()
                                     for d, s in self._graduated.items()}
             cur_seqs = {d: self.deli.doc_seq(d) for d in self._doc_rows}
-        self._dirty_outside_ops.clear()
-        self._summ_bookkeeping = {
-            "summary": summary,
-            "doc_seqs": cur_seqs,
-            "row_of": dict(self._doc_rows),
-            "payloads_len": len(self.store._payloads),
-            "prop_values_len": len(self.store._prop_values),
-        }
+        self._note_summary(summary, cur_seqs,
+                           payloads_len=len(self.store._payloads),
+                           prop_values_len=len(self.store._prop_values))
         return summary
 
     @classmethod
@@ -1230,13 +1326,9 @@ class StringServingEngine(ServingEngineBase):
         re-shards the restored planes (recovery onto a fresh mesh).
         Incremental summaries resolve their base chain: the newest full
         summary restores, then each delta's dirty rows overwrite."""
-        chain = []
-        full = summary
-        while full.get("kind") == "delta":
-            chain.append(full)
-            full = full["base"]
+        full, deltas = cls.resolve_summary_chain(summary)
         store = TensorStringStore.restore(full["store"], mesh=mesh)
-        for delta in reversed(chain):
+        for delta in deltas:
             store.apply_row_snapshot(delta["store_delta"])
         mega = None
         if summary.get("mega_store") is not None:
@@ -1496,10 +1588,31 @@ class MapServingEngine(ServingEngineBase):
 
     # ----------------------------------------------------- summary / recovery
 
-    def summarize(self) -> dict:
+    def summarize(self, incremental: bool = False) -> dict:
+        """``incremental=True`` (after one full summary) captures a
+        DELTA: only rows whose doc sequenced an op since the base —
+        detected host-side from the sequencer, no device read — plus
+        rows whose mapping changed, plus the append-only value-interner
+        delta; clean rows ride by reference to the base summary
+        (SURVEY.md §2.16)."""
         self.flush()
-        summary = self._base_summary()
-        summary["store"] = self.store.snapshot()
+        prev = self._summ_bookkeeping
+        if self._incremental_ok(incremental):
+            dirty_rows, cur_seqs = self._dirty_rows_since(prev)
+            summary = self._base_summary()
+            summary["kind"] = "delta"
+            summary["base"] = prev["summary"]
+            summary["store_delta"] = self.store.snapshot_rows(
+                sorted(dirty_rows), prev["values_len"])
+            self._chain_depth += 1
+        else:
+            summary = self._base_summary()
+            summary["kind"] = "full"
+            self._chain_depth = 0
+            summary["store"] = self.store.snapshot()
+            cur_seqs = {d: self.deli.doc_seq(d) for d in self._doc_rows}
+        self._note_summary(summary, cur_seqs,
+                           values_len=len(self.store._interner))
         return summary
 
     @classmethod
@@ -1507,8 +1620,13 @@ class MapServingEngine(ServingEngineBase):
              **kwargs) -> "MapServingEngine":
         """Summary + tail replay through the same apply path (the single
         recovery primitive, as in the string engine). ``mesh`` re-shards
-        the restored planes."""
-        store = TensorMapStore.restore(summary["store"], mesh=mesh)
+        the restored planes. Incremental summaries resolve their base
+        chain: the newest full summary restores, then each delta's dirty
+        rows overwrite."""
+        full, deltas = cls.resolve_summary_chain(summary)
+        store = TensorMapStore.restore(full["store"], mesh=mesh)
+        for delta in deltas:
+            store.apply_row_snapshot(delta["store_delta"])
         engine = cls(store.n_docs, store.n_keys, log=log, store=store,
                      **kwargs)
         engine._restore_base(summary)
@@ -1548,20 +1666,40 @@ class MatrixServingEngine(ServingEngineBase):
                  batch_window: int = 64, n_partitions: int = 8,
                  log: Optional[PartitionedLog] = None,
                  store=None, axis_capacity: int = 256,
-                 axis_store=None, sequencer: str = "python"):
+                 axis_store=None, sequencer: str = "python", mesh=None):
+        """``mesh``: a 1-D ``docs`` device mesh shards BOTH matrix
+        stores by doc block — the axis rows (2 per doc, adjacent) and
+        the cell pool (``ShardedMatrixStore``: cells are doc-scoped, so
+        each shard sort-merges its own docs' cells) — every apply a
+        collective-free shard_map (SURVEY.md §2.14)."""
         from ..ops.axis_kernel import TensorAxisStore
-        from ..ops.matrix_kernel import TensorMatrixStore
+        from ..ops.matrix_kernel import (
+            ShardedMatrixStore, TensorMatrixStore)
         super().__init__(batch_window, n_partitions, log=log,
                          sequencer=sequencer)
-        self.store = store if store is not None \
-            else TensorMatrixStore(cell_capacity)
+        if mesh is not None:
+            for s in (store, axis_store):
+                if s is not None and getattr(s, "mesh", None) is not mesh:
+                    raise ValueError(
+                        "mesh given with a store not sharded over it")
+        if store is not None:
+            self.store = store
+        elif mesh is not None:
+            self.store = ShardedMatrixStore(cell_capacity, mesh, n_docs)
+        else:
+            self.store = TensorMatrixStore(cell_capacity)
         self.axis_store = axis_store if axis_store is not None \
-            else TensorAxisStore(n_docs, axis_capacity)
+            else TensorAxisStore(n_docs, axis_capacity, mesh=mesh)
+        self.mesh = mesh
         self.n_docs = n_docs
         self._fww: Dict[int, bool] = {}
         # per-doc {cell: (seq, writer)} — the FWW visibility metadata
         self._cell_meta: Dict[int, Dict] = {}
         self._pending_setcells = 0  # queued setCells (capacity reservation)
+        # deferred cell-ingest batches awaiting their resolve harvest
+        # (the pipelining that removes the per-batch device round trip)
+        self._pending_cells: List[dict] = []
+        self._pending_cell_count = 0
         self._init_row_caches(n_docs)
         # conservative per-axis slot usage bound (each admitted axis op
         # adds at most 2 slots: an insert, or a remove's two splits);
@@ -1631,8 +1769,11 @@ class MatrixServingEngine(ServingEngineBase):
             # identities never shrink, and each queued setCell may mint one
             # more — past this bound the device table would silently drop
             # ACKED live cells at truncation, so nack before logging
-            if len(self.store._cell_ids) + self._pending_setcells \
-                    >= self.store.capacity:
+            if not self.store.conservative_room(
+                    self._pending_setcells + self._pending_cell_count):
+                # deferred columnar batches' identities are not yet
+                # interned — count them or an acked op could overflow
+                # the table at harvest time
                 raise KeyError("cell table capacity exhausted")
             self._pending_setcells += 1
 
@@ -1647,7 +1788,10 @@ class MatrixServingEngine(ServingEngineBase):
         """Batch the window into per-axis-row op planes — axis mutations
         AND setCell position resolves in one scan — then FWW-filter the
         resolved key stream and merge the surviving cell writes. Exactly
-        one device dispatch + one device→host read per flush."""
+        one device dispatch + one device→host read per flush. Deferred
+        columnar cell batches harvest FIRST (per-doc seq order: they were
+        sequenced before anything in this queue)."""
+        self._harvest_cells()
         n = len(self._queue)
         if not n:
             return n
@@ -1761,10 +1905,12 @@ class MatrixServingEngine(ServingEngineBase):
         cpos = np.ascontiguousarray(cpos, np.int32)
         if len(rpos) and (int(rpos.min()) < 0 or int(cpos.min()) < 0):
             raise ValueError("negative cell position")
-        self.flush()  # per-op queue first: per-doc seq order must hold
+        if self._queue:   # per-op queue first: per-doc seq order holds
+            self.flush()  # (also harvests any deferred cell batches)
         rows = np.fromiter((self.doc_row(d) for d in doc_ids), np.int32,
                            count=n)
-        if len(self.store._cell_ids) + n >= self.store.capacity:
+        if not self.store.conservative_room(
+                n + self._pending_cell_count):
             raise KeyError("cell table capacity exhausted")
         client = np.ascontiguousarray(clients, np.int32)
         for i in range(n):  # mint axis client slots BEFORE sequencing
@@ -1785,57 +1931,68 @@ class MatrixServingEngine(ServingEngineBase):
         # and recovered state silently diverge
         ref_clamped = self._clamped_ref(ref, out_seq)
 
-        # one resolve-only axis scan for every accepted op
-        per_axis: Dict[int, list] = {}
-        slots = []
-        for i in ok:
-            row = int(rows[i])
-            ar, ac = 2 * row, 2 * row + 1
-            rl = per_axis.setdefault(ar, [])
-            cl_ = per_axis.setdefault(ac, [])
-            rl.append((int(OpKind.AXIS_RESOLVE), int(rpos[i]), 0, 0,
-                       int(out_seq[i]),
-                       self.axis_store.client(ar, int(client[i])),
-                       int(ref_clamped[i])))
-            cl_.append((int(OpKind.AXIS_RESOLVE), int(cpos[i]), 0, 0,
-                       int(out_seq[i]),
-                       self.axis_store.client(ac, int(client[i])),
-                       int(ref_clamped[i])))
-            slots.append((ar, len(rl) - 1, ac, len(cl_) - 1))
-        records = []
-        contents_tab = []
-        if per_axis:
-            rh, ro = self._dispatch_axis(per_axis)
-            for j, i in enumerate(ok):
-                row = int(rows[i])
-                ar, rs, ac, cs = slots[j]
-                contents_tab.append(
-                    {"mx": "setCell", "row": int(rpos[i]),
-                     "col": int(cpos[i]), "value": values[i]})
-                if rh[ar, rs] < 0 or rh[ac, cs] < 0:
-                    continue  # out of range at perspective: drop
-                rk = self.axis_store.run_key(int(rh[ar, rs]),
-                                             int(ro[ar, rs]))
-                ck = self.axis_store.run_key(int(rh[ac, cs]),
-                                             int(ro[ac, cs]))
-                self._fww.setdefault(row, False)
-                meta = self._cell_meta.setdefault(row, {})
-                cell = (rk, ck)
-                if self._fww[row]:
-                    sq, writer = meta.get(cell, (0, None))
-                    if sq > int(ref_clamped[i]) and \
-                            writer != int(client[i]):
-                        continue
-                meta[cell] = (int(out_seq[i]), int(client[i]))
-                records.append(((row, rk), ck, values[i],
-                                int(out_seq[i])))
-        if records:
-            self.store.apply_batch(records)
+        # ONE mutation-free resolve dispatch for every accepted op,
+        # packed vectorized: op i contributes entry 2j (its row axis)
+        # and 2j+1 (its col axis) — per-axis slot order = op order
+        pend = None
+        if len(ok):
+            from ..ops.tree_store import positions_in_doc
+            rows_ok = rows[ok].astype(np.int64)
+            ar, ac = 2 * rows_ok, 2 * rows_ok + 1
+            k2 = len(ok) * 2
+            axis_arr = np.empty(k2, np.int64)
+            axis_arr[0::2] = ar
+            axis_arr[1::2] = ac
+            pos_in_axis, widest = positions_in_doc(axis_arr)
+            o = 8
+            while o < widest:
+                o *= 2
+            d2 = 2 * self.n_docs
+            planes = {name: np.zeros((d2, o), np.int32)
+                      for name in ("kind", "a0", "a1", "a2", "seq",
+                                   "client", "ref_seq")}
+            # client slot LUT: one interner hit per UNIQUE (axis, client)
+            slot2 = np.empty(k2, np.int32)
+            cl2 = np.empty(k2, np.int64)
+            cl2[0::2] = client[ok]
+            cl2[1::2] = client[ok]
+            pairs = axis_arr * (1 << 32) + cl2
+            uniq, inv = np.unique(pairs, return_inverse=True)
+            lut = np.fromiter(
+                (self.axis_store.client(int(p >> 32),
+                                        int(p & 0xFFFFFFFF))
+                 for p in uniq), np.int32, count=len(uniq))
+            slot2 = lut[inv]
+            a0 = np.empty(k2, np.int64)
+            a0[0::2] = rpos[ok]
+            a0[1::2] = cpos[ok]
+            sq2 = np.repeat(out_seq[ok], 2)
+            rf2 = np.repeat(ref_clamped[ok], 2)
+            planes["kind"][axis_arr, pos_in_axis] = int(
+                OpKind.AXIS_RESOLVE)
+            planes["a0"][axis_arr, pos_in_axis] = a0
+            planes["seq"][axis_arr, pos_in_axis] = sq2
+            planes["client"][axis_arr, pos_in_axis] = slot2
+            planes["ref_seq"][axis_arr, pos_in_axis] = rf2
+            rh_dev, ro_dev = self.axis_store.resolve_async(planes)
+            pend = {
+                "rh": rh_dev, "ro": ro_dev,
+                "axis": axis_arr, "pos": pos_in_axis,
+                "rows": rows_ok, "client": client[ok].copy(),
+                "ref": ref_clamped[ok].copy(),
+                "seq": out_seq[ok].copy(),
+                "values": [values[i] for i in ok],
+            }
 
-        # whole-batch durable record (family "ops")
+        # whole-batch durable record (family "ops") — appended before the
+        # deferred merge harvest (the record holds RAW ops; recovery
+        # replays them through the same resolve+filter path)
         ts = self.deli.clock()
         id_tab = sorted(set(doc_ids))
         id_of = {d: i for i, d in enumerate(id_tab)}
+        contents_tab = [{"mx": "setCell", "row": int(rpos[i]),
+                         "col": int(cpos[i]), "value": values[i]}
+                        for i in ok]
         self._append_columnar(ColumnarOps(
             id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
                                 count=len(ok)),
@@ -1846,10 +2003,61 @@ class MatrixServingEngine(ServingEngineBase):
             text="", timestamp=ts, family="ops", values=contents_tab))
         for i in ok:
             self._min_seq[doc_ids[i]] = int(out_min[i])
+        if pend is not None:
+            self._pending_cells.append(pend)
+            self._pending_cell_count += len(pend["rows"])
+        # pipeline: harvest every batch but the newest (its resolve —
+        # and the async host copy — overlap the caller's next batch)
+        self._harvest_cells(keep_newest=True)
         self.metrics.inc("flushes")
         self.metrics.inc("ops_flushed", n_ok)
         self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
         return {"seq": out_seq, "nacked": int(nacked.sum())}
+
+    def _harvest_cells(self, keep_newest: bool = False) -> None:
+        """Finish deferred cell-ingest batches in FIFO order: read the
+        (by now usually landed) resolve results, run the FWW filter on
+        the resolved keys, and dispatch the cell merge. ``keep_newest``
+        leaves the most recent batch in flight — the pipelining that
+        removes the blocking per-batch device round-trip (VERDICT r4
+        weak #3)."""
+        limit = len(self._pending_cells) - (1 if keep_newest else 0)
+        for _ in range(max(limit, 0)):
+            pend = self._pending_cells.pop(0)
+            self._pending_cell_count -= len(pend["rows"])
+            try:
+                rh = np.asarray(pend["rh"])
+                ro = np.asarray(pend["ro"])
+            except Exception as e:   # device fault: state may lag log
+                self._poisoned = f"cell resolve harvest failed: {e!r}"
+                self._pending_cells.clear()
+                raise
+            axis, pos = pend["axis"], pend["pos"]
+            rh2 = rh[axis, pos]
+            ro2 = ro[axis, pos]
+            records = []
+            run_key = self.axis_store.run_key
+            for j in range(len(pend["rows"])):
+                hr, hc = int(rh2[2 * j]), int(rh2[2 * j + 1])
+                if hr < 0 or hc < 0:
+                    continue  # out of range at perspective: drop
+                row = int(pend["rows"][j])
+                rk = run_key(hr, int(ro2[2 * j]))
+                ck = run_key(hc, int(ro2[2 * j + 1]))
+                self._fww.setdefault(row, False)
+                meta = self._cell_meta.setdefault(row, {})
+                cell = (rk, ck)
+                if self._fww[row]:
+                    sq, writer = meta.get(cell, (0, None))
+                    if sq > int(pend["ref"][j]) and \
+                            writer != int(pend["client"][j]):
+                        continue
+                meta[cell] = (int(pend["seq"][j]),
+                              int(pend["client"][j]))
+                records.append(((row, rk), ck, pend["values"][j],
+                                int(pend["seq"][j])))
+            if records:
+                self.store.apply_batch(records)
 
     def _dispatch_axis(self, per_axis: Dict[int, list]):
         """Dense (2·D, O) planes from per-axis op lists → one scan.
@@ -1872,6 +2080,7 @@ class MatrixServingEngine(ServingEngineBase):
     def overflowed(self) -> bool:
         """Sticky device overflow (cell table or an axis row): True means
         re-bucket with a larger table / axis capacity."""
+        self._harvest_cells()
         return bool(self.store.overflowed()) or \
             bool(self.axis_store.overflowed().any())
 
@@ -1934,32 +2143,95 @@ class MatrixServingEngine(ServingEngineBase):
 
     # ----------------------------------------------------- summary / recovery
 
-    def summarize(self) -> dict:
+    def summarize(self, incremental: bool = False) -> dict:
+        """``incremental=True`` (after one full summary) captures a
+        DELTA: dirty docs' axis rows (fused gather) + their FWW/cell
+        metadata, plus the cell pool — trimmed to LIVE cells and skipped
+        entirely when no doc is dirty (the pool is key-sorted and
+        globally re-merged every batch, so its delta granularity is the
+        pool, bounded by live cells, not by history). Append-only
+        identity/value tables ride as deltas; clean rows by reference to
+        the base (SURVEY.md §2.16)."""
         self.flush()
         self.compact()
-        summary = self._base_summary()
-        summary["store"] = self.store.snapshot()
-        summary["axis_store"] = self.axis_store.snapshot()
-        summary["fww"] = dict(self._fww)
-        summary["cell_meta"] = {row: list(m.items())
-                                for row, m in self._cell_meta.items()}
-        summary["n_docs"] = self.n_docs
+        prev = self._summ_bookkeeping
+        if self._incremental_ok(incremental):
+            dirty_rows, cur_seqs = self._dirty_rows_since(prev)
+            dirty = sorted(dirty_rows)
+            summary = self._base_summary()
+            summary["kind"] = "delta"
+            summary["base"] = prev["summary"]
+            summary["cells_delta"] = self.store.snapshot_delta(
+                prev["mx_bases"]) if dirty else None
+            axis_rows = [a for r in dirty for a in (2 * r, 2 * r + 1)]
+            summary["axis_delta"] = self.axis_store.snapshot_rows(
+                axis_rows, prev["runs_len"])
+            # per-dirty-row host metadata overlays (None = clear)
+            summary["fww_delta"] = {r: self._fww.get(r) for r in dirty}
+            summary["cell_meta_delta"] = {
+                r: (list(self._cell_meta[r].items())
+                    if r in self._cell_meta else None) for r in dirty}
+            summary["n_docs"] = self.n_docs
+            self._chain_depth += 1
+        else:
+            summary = self._base_summary()
+            summary["kind"] = "full"
+            self._chain_depth = 0
+            summary["store"] = self.store.snapshot()
+            summary["axis_store"] = self.axis_store.snapshot()
+            summary["fww"] = dict(self._fww)
+            summary["cell_meta"] = {row: list(m.items())
+                                    for row, m in self._cell_meta.items()}
+            summary["n_docs"] = self.n_docs
+            cur_seqs = {d: self.deli.doc_seq(d) for d in self._doc_rows}
+        self._note_summary(summary, cur_seqs,
+                           mx_bases=self.store.table_bases(),
+                           runs_len=len(self.axis_store._runs))
         return summary
 
     @classmethod
-    def load(cls, summary: dict, log: PartitionedLog,
+    def load(cls, summary: dict, log: PartitionedLog, mesh=None,
              **kwargs) -> "MatrixServingEngine":
         from ..ops.axis_kernel import TensorAxisStore
-        from ..ops.matrix_kernel import TensorMatrixStore, tuple_key
-        store = TensorMatrixStore.restore(summary["store"])
-        axis = TensorAxisStore.restore(summary["axis_store"])
-        engine = cls(summary["n_docs"], log=log, store=store,
-                     axis_store=axis, **kwargs)
-        engine._restore_base(summary)
-        engine._fww = dict(summary["fww"])
-        engine._cell_meta = {
+        from ..ops.matrix_kernel import (
+            ShardedMatrixStore, TensorMatrixStore, tuple_key)
+        full, deltas = cls.resolve_summary_chain(summary)
+        if "sharded_docs" in full["store"]:
+            if mesh is None:
+                raise ValueError("sharded matrix summary needs mesh=")
+            store = ShardedMatrixStore.restore(full["store"], mesh)
+        elif mesh is not None:
+            raise ValueError("mesh= given for an unsharded matrix "
+                             "summary; re-shard by rebuilding the store")
+        else:
+            store = TensorMatrixStore.restore(full["store"])
+        axis = TensorAxisStore.restore(full["axis_store"], mesh=mesh)
+        fww = dict(full["fww"])
+        cell_meta = {
             row: {tuple_key(cell): tuple(sw) for cell, sw in items}
-            for row, items in summary["cell_meta"].items()}
+            for row, items in full["cell_meta"].items()}
+        for delta in deltas:
+            if delta["cells_delta"] is not None:
+                store.apply_delta(delta["cells_delta"])
+            axis.apply_row_snapshot(delta["axis_delta"])
+            for r, v in delta["fww_delta"].items():
+                r = int(r)
+                if v is None:
+                    fww.pop(r, None)
+                else:
+                    fww[r] = v
+            for r, items in delta["cell_meta_delta"].items():
+                r = int(r)
+                if items is None:
+                    cell_meta.pop(r, None)
+                else:
+                    cell_meta[r] = {tuple_key(cell): tuple(sw)
+                                    for cell, sw in items}
+        engine = cls(summary["n_docs"], log=log, store=store,
+                     axis_store=axis, mesh=mesh, **kwargs)
+        engine._restore_base(summary)
+        engine._fww = fww
+        engine._cell_meta = cell_meta
         # re-base the axis-slot admission bound from the restored planes
         # (a zeroed bound would admit ops the full axis cannot hold)
         engine._axis_used = np.asarray(axis.state.count,
@@ -1989,12 +2261,21 @@ class TreeServingEngine(ServingEngineBase):
                  batch_window: int = 64, n_partitions: int = 8,
                  log: Optional[PartitionedLog] = None,
                  store: Optional["TensorTreeStore"] = None,
-                 sequencer: str = "python"):
+                 sequencer: str = "python", mesh=None):
+        """``mesh``: a 1-D ``docs`` device mesh shards the tree planes by
+        doc row; every batched apply runs as a collective-free shard_map
+        of the same record scan (SURVEY.md §2.14 doc-DP for the tree
+        tier; the compact wire path falls back to dense packed planes,
+        which shard row-wise)."""
         from ..ops.tree_store import TensorTreeStore
         super().__init__(batch_window, n_partitions, log=log,
                          sequencer=sequencer)
+        if store is not None and mesh is not None \
+                and getattr(store, "mesh", None) is not mesh:
+            raise ValueError("mesh given with a store not sharded over it")
         self.store = store if store is not None \
-            else TensorTreeStore(n_docs, capacity)
+            else TensorTreeStore(n_docs, capacity, mesh=mesh)
+        self.mesh = getattr(self.store, "mesh", mesh)
         self.n_docs = n_docs
         self.capacity = self.store.capacity
         self._init_row_caches(n_docs)
@@ -2002,6 +2283,19 @@ class TreeServingEngine(ServingEngineBase):
         # own single-doc store sharing the main store's interners
         self._graduated: Dict[str, Any] = {}
         self._grad_queue: Dict[str, List[SequencedDocumentMessage]] = {}
+
+    def allocate_node_ids(self, count: int) -> int:
+        """Reserve a cluster of ``count`` numeric node ids; returns the
+        base handle (ids are the strings ``#<base>``..``#<base+count-1>``,
+        never interned). The id-compressor role (SURVEY.md §2.11): the
+        columnar hot path ships ids as ints, so serving never touches a
+        string table."""
+        return self.store._ids.reserve(count)
+
+    def sync(self) -> np.ndarray:
+        """Device→host read of the per-row overflow flags — the honest
+        end-of-pipeline sync a sequencer ack path does."""
+        return np.asarray(self.store.state.overflow)
 
     # ------------------------------------------------------------ validation
 
@@ -2061,7 +2355,13 @@ class TreeServingEngine(ServingEngineBase):
             except (TypeError, ValueError):
                 return False
             return True
-        # transaction
+        # transaction — top-level only: a nested transaction's constraints
+        # cannot share the single device gate (ok_txn), and the client API
+        # cannot produce one ("transactions do not nest",
+        # models/shared_tree.py) — reject at ingress rather than silently
+        # dropping the inner constraints as the old expansion did
+        if depth > 0:
+            return False
         cons = op.get("constraints", [])
         if not (isinstance(cons, list)
                 and all(isinstance(c, dict)
@@ -2112,22 +2412,266 @@ class TreeServingEngine(ServingEngineBase):
 
     # ------------------------------------------------------- columnar ingest
 
+    def _validate_record_batch(self, batch: dict, n_ops: int):
+        """Bounds-validate a wire record batch (tree_wire module
+        docstring). Only BOUNDS need checking for state safety: the
+        kernel guards every merge rule on device, and recovery replays
+        the same raw planes — a weird-but-bounded stream cannot make
+        live and recovered state diverge."""
+        rec_op = np.ascontiguousarray(batch["rec_op"], np.int64)
+        recs = np.ascontiguousarray(batch["recs"], np.int32)
+        if recs.ndim != 2 or recs.shape[1] != 8 \
+                or recs.shape[0] != len(rec_op):
+            raise ValueError("record planes malformed")
+        r = len(rec_op)
+        if r and (rec_op[0] < 0 or rec_op[-1] >= n_ops
+                  or np.any(np.diff(rec_op) < 0)):
+            raise ValueError("rec_op must ascend within the op batch")
+        # every op owns ≥1 record: a record-less op would be sequenced
+        # but invisible to the seq-derivation and decode paths
+        if not np.array_equal(np.unique(rec_op), np.arange(n_ops)):
+            raise ValueError("rec_op must cover every op in the batch")
+        from ..ops.tree_store import ANON_BASE
+        # id entries may be ints: pre-compressed numeric handles from the
+        # id-compressor namespace (passed through with no interning)
+        if not all((isinstance(s, str) and s)
+                   or (isinstance(s, int) and not isinstance(s, bool)
+                       and ANON_BASE <= s < (1 << 31))
+                   for s in batch["ids"]):
+            raise ValueError("every id table entry must be a non-empty "
+                             "str or a numeric handle in the anonymous "
+                             "namespace")
+        for tab, what in ((batch["fields"], "field"),
+                          (batch["types"], "type")):
+            if not all(isinstance(s, str) and s for s in tab):
+                raise ValueError(
+                    f"every {what} table entry must be a non-empty str")
+        try:  # values land in the durable record and the interner
+            json.dumps(batch["values"], sort_keys=True)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"unserializable value table: {e}") from None
+        if r:
+            k = recs[:, 0]
+            if not ((k >= 1) &
+                    (k <= int(TreeOpKind.TXN_BEGIN_EXISTS))).all():
+                raise ValueError("record kind out of range")
+            for col, size, what in (
+                    (1, len(batch["ids"]), "node"),
+                    (2, len(batch["ids"]), "parent"),
+                    (3, len(batch["ids"]), "after"),
+                    (4, len(batch["fields"]), "field"),
+                    (5, len(batch["values"]), "value"),
+                    (6, len(batch["types"]), "type")):
+                c = recs[:, col]
+                if not ((c >= 0) & (c <= size)).all():
+                    raise ValueError(f"{what} handle out of table bounds")
+            me = recs[:, 7]
+            if not ((me >= 0) & (me <= 1)).all():
+                raise ValueError("record meta out of range")
+        return rec_op, recs
+
+    def _map_records(self, recs: np.ndarray, tables: dict) -> np.ndarray:
+        """Batch-local table indices → store interner handles: one dict
+        hit per UNIQUE string/value, then vectorized gathers."""
+        def table_map(items, interner):
+            m = np.zeros(len(items) + 1, np.int32)
+            if items:
+                m[1:] = interner.bulk(items)
+            return m
+
+        id_map = table_map(tables["ids"], self.store._ids)
+        f_map = table_map(tables["fields"], self.store._fields)
+        t_map = table_map(tables["types"], self.store._types)
+        v_map = table_map(tables["values"], self.store._values)
+        g = np.empty_like(recs)
+        g[:, 0] = recs[:, 0]
+        g[:, 1] = id_map[recs[:, 1]]
+        g[:, 2] = id_map[recs[:, 2]]
+        g[:, 3] = id_map[recs[:, 3]]
+        g[:, 4] = f_map[recs[:, 4]]
+        g[:, 5] = v_map[recs[:, 5]]
+        g[:, 6] = t_map[recs[:, 6]]
+        g[:, 7] = recs[:, 7]
+        return g
+
+    def _wire_eligible(self, batch: dict) -> bool:
+        """Can this batch ride the compact width-coded wire? (Tables must
+        fit the narrow index widths; huge batches — and mesh stores,
+        whose dense planes shard row-wise — take the dense path.)"""
+        return (self.mesh is None
+                and len(batch["ids"]) < 0xFFFF
+                and len(batch["fields"]) < 0xFF
+                and len(batch["types"]) < 0xFF
+                and len(batch["values"]) < 0xFFFF
+                and self.n_docs <= 0x10000)
+
+    _WIRE_R_FLOOR = 256   # pow2 record-padding floor (bounds recompiles)
+
+    def _dispatch_wire(self, batch, recs, rec_op, keep, rows, out_seq,
+                       nacked):
+        """Pack kept records into the width-coded wire buffers and
+        dispatch ``apply_tree_wire`` (upload bytes are the bottleneck —
+        see tree_kernel). Returns the prep/dispatch split timestamp, or
+        None when the dense path must handle the batch (oversized o)."""
+        from ..ops.tree_store import _pow2_at_least, pack_wire_records
+        recs_k = recs[keep]
+        rec_op_k = rec_op[keep]
+        rows_r = rows[rec_op_k].astype(np.int64)
+        packed = pack_wire_records(recs_k, rec_op_k, rows_r,
+                                   r_floor=self._WIRE_R_FLOOR)
+        if packed is None:
+            return None
+        cols, idsb, valsb, rowb, posb, o = packed
+        # per-doc first-op seq (op seqs are consecutive per doc in-batch)
+        base = np.zeros(self.n_docs, np.int32)
+        ok = np.flatnonzero(~nacked)
+        if len(ok):
+            rows_ok = rows[ok]
+            uniq, firsti = np.unique(rows_ok, return_index=True)
+            base[uniq] = out_seq[ok][firsti].astype(np.int32)
+
+        def pad_map(items, interner):
+            m = np.zeros(_pow2_at_least(len(items) + 1, floor=8),
+                         np.int32)
+            if items:
+                m[1:len(items) + 1] = interner.bulk(items)
+            return m
+
+        id_map = pad_map(batch["ids"], self.store._ids)
+        f_map = pad_map(batch["fields"], self.store._fields)
+        t_map = pad_map(batch["types"], self.store._types)
+        v_map = pad_map(batch["values"], self.store._values)
+        t_prep = time.perf_counter()
+        self.store.apply_wire(cols, idsb, valsb, rowb, posb, base,
+                              id_map, f_map, t_map, v_map, o)
+        return t_prep
+
+    def ingest_records(self, doc_ids: Optional[List[str]], clients,
+                       client_seqs, ref_seqs, batch: dict,
+                       rows: Optional[np.ndarray] = None) -> dict:
+        """The tree GENERAL volume path: N edits of any kind (op i
+        targets ``doc_ids[i]``; per-doc order = list order) arriving
+        PRE-ENCODED in the columnar record wire format
+        (``server.tree_wire``) — one native sequencing call, one
+        vectorized table→interner mapping, one batched device apply, one
+        raw-plane durable record (``TreeRecordOps``). Nacked ops' records
+        are dropped everywhere. Callers on the hot path pass cached
+        ``rows`` (from ``doc_row``) instead of ``doc_ids``; cached rows
+        are invalidated when ``recover_overflowed`` graduates a doc
+        (re-resolve after recovery, as with the string engine). Returns
+        {"seq": (N,) (negative = nack code), "nacked"}."""
+        self._check_poisoned()
+        raw = getattr(self.deli, "raw", None)
+        if raw is None:
+            raise RuntimeError("batch ingest requires sequencer='native'")
+        n = len(doc_ids) if rows is None else len(rows)
+        if not (len(clients) == len(client_seqs) == len(ref_seqs) == n):
+            raise ValueError("batch fields must have equal length")
+        rec_op, recs = self._validate_record_batch(batch, n)
+        if rows is None:
+            if self._graduated and any(d in self._graduated
+                                       for d in doc_ids):
+                raise ValueError("a targeted doc has graduated off the "
+                                 "flat tier; route its ops through "
+                                 "submit()")
+            self.flush()  # per-op queue first: per-doc seq order holds
+            rows = np.fromiter((self.doc_row(d) for d in doc_ids),
+                               np.int32, count=n)
+        else:
+            rows = np.ascontiguousarray(rows, np.int32)
+            if n and not ((rows >= 0) & (rows < self.n_docs)).all():
+                raise ValueError("row out of range")
+            self.flush()
+        uniq_rows = np.unique(rows)
+        # unknown rows fail in _fill_row_handles (no doc → KeyError)
+        self._fill_row_handles(uniq_rows, raw)
+        t0 = time.perf_counter()
+        client = np.ascontiguousarray(clients, np.int32)
+        cseq = np.ascontiguousarray(client_seqs, np.int32)
+        ref = np.ascontiguousarray(ref_seqs, np.int32)
+        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
+            raw, self._row_handle[rows], client, cseq, ref,
+            "tree records batch")
+        _t_seq = time.perf_counter()
+
+        keep = ~nacked[rec_op] if len(rec_op) else np.zeros(0, bool)
+        _t_prep = None
+        if self._wire_eligible(batch):
+            _t_prep = self._dispatch_wire(batch, recs, rec_op, keep,
+                                          rows, out_seq, nacked)
+        if _t_prep is None:
+            # dense fallback: host-side table mapping + int32 planes
+            g = self._map_records(recs, batch)
+            rows_r = rows[rec_op][keep]
+            g_k = g[keep]
+            seq_r = out_seq[rec_op][keep]
+            _t_prep = time.perf_counter()
+            # device apply dispatched before the log append (host log
+            # work rides under it), exactly the string pipeline's order
+            self.store.apply_records(rows_r, g_k, seq_r)
+        _t_apply = time.perf_counter()
+
+        ok = np.flatnonzero(~nacked)
+        ts = self.deli.clock()
+        doc_tab = [self._row_doc_id[int(r)] for r in uniq_rows]
+        doc_plane = np.searchsorted(uniq_rows, rows[ok]).astype(np.int32)
+        new_idx = np.cumsum(~nacked) - 1   # op index among kept ops
+        ref_clamped = self._clamped_ref(ref, out_seq)
+        self._append_columnar(TreeRecordOps(
+            doc_tab, doc_plane,
+            client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
+            out_min[ok], new_idx[rec_op][keep],
+            np.ascontiguousarray(recs[keep]),
+            list(batch["ids"]), list(batch["fields"]),
+            list(batch["types"]), list(batch["values"]), timestamp=ts))
+        _t_log = time.perf_counter()
+        if len(ok):
+            # per-doc window floor: the LAST op of each doc carries its
+            # latest min_seq (one dict write per doc, not per op)
+            rows_ok = rows[ok]
+            order = np.argsort(rows_ok, kind="stable")
+            rs = rows_ok[order]
+            ms = out_min[ok][order]
+            starts = np.r_[0, np.flatnonzero(np.diff(rs)) + 1]
+            lasts = np.r_[starts[1:] - 1, len(rs) - 1]
+            for r, m in zip(rs[starts], ms[lasts]):
+                self._min_seq[self._row_doc_id[int(r)]] = int(m)
+        self.metrics.inc("flushes")
+        self.metrics.inc("ops_flushed", n_ok)
+        self.metrics.observe("ingest_seq_ms", (_t_seq - t0) * 1000)
+        self.metrics.observe("ingest_prep_ms", (_t_prep - _t_seq) * 1000)
+        self.metrics.observe("ingest_dispatch_ms",
+                             (_t_apply - _t_prep) * 1000)
+        self.metrics.observe("ingest_log_ms", (_t_log - _t_apply) * 1000)
+        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        return {"seq": out_seq, "nacked": int(nacked.sum())}
+
+    def ingest_batch(self, doc_ids: List[str], clients, client_seqs,
+                     ref_seqs, ops: List[dict]) -> dict:
+        """Dict-op convenience over ``ingest_records``: validate + encode
+        each op through the canonical ``RecordEmitter`` (the per-op host
+        cost a real client would pay at serialization time), then run the
+        columnar record path — no per-op message objects, no queue
+        drain. Returns {"seq": (N,), "nacked"}."""
+        if len(ops) != len(doc_ids):
+            raise ValueError("batch fields must have equal length")
+        for op in ops:
+            if not self._valid_op(op):
+                raise ValueError(f"malformed tree op {op!r}")
+        from .tree_wire import encode_tree_batch
+        return self.ingest_records(doc_ids, clients, client_seqs, ref_seqs,
+                                   encode_tree_batch(ops))
+
     def ingest_leaves(self, doc_ids: List[str], clients, client_seqs,
                       ref_seqs, parents: List[str], fields: List[str],
                       node_ids: List[str], values: list,
                       types: Optional[List[str]] = None,
                       afters: Optional[List[Optional[str]]] = None
                       ) -> dict:
-        """The tree volume path: N FLAT single-node inserts (op i creates
-        ``node_ids[i]`` under ``parents[i]``/``fields[i]``) — one native
-        sequencing call, one VECTORIZED device apply (no per-op dict
-        translation anywhere), one whole-batch durable record (family
-        "tree_flat"). General edits (transactions, moves, removes,
-        subtree specs) go through ``ingest_batch``/``submit``."""
-        self._check_poisoned()
-        raw = getattr(self.deli, "raw", None)
-        if raw is None:
-            raise RuntimeError("batch ingest requires sequencer='native'")
+        """The tree FLAT volume path: N single-node inserts (op i creates
+        ``node_ids[i]`` under ``parents[i]``/``fields[i]``), each ONE
+        ``INSERT_SOLO`` record — built as arrays here and run through
+        ``ingest_records``."""
         n = len(node_ids)
         types = types if types is not None else [None] * n
         afters = afters if afters is not None else [None] * n
@@ -2144,132 +2688,38 @@ class TreeServingEngine(ServingEngineBase):
         if not all(a is None or (isinstance(a, str) and a)
                    for a in afters):
             raise ValueError("every after must be a non-empty str or None")
-        try:  # values land in the log's JSON extras and the interner
-            # (sort_keys matches ValueInterner's canonical encoding — a
-            # value only dumps-able unsorted would crash post-sequencing)
+        try:  # values land in the durable record and the interner
+            # (sort_keys matches the canonical value encoding — a value
+            # only dumps-able unsorted would crash post-sequencing)
             json.dumps(values, sort_keys=True)
         except (TypeError, ValueError) as e:
             raise ValueError(f"unserializable node value: {e}") from None
-        if self._graduated and any(d in self._graduated for d in doc_ids):
-            raise ValueError("a targeted doc has graduated off the flat "
-                             "tier; route its ops through submit()")
-        self.flush()
-        rows = np.fromiter((self.doc_row(d) for d in doc_ids), np.int32,
-                           count=n)
-        self._fill_row_handles(np.unique(rows), raw)
-        t0 = time.perf_counter()
-        client = np.ascontiguousarray(clients, np.int32)
-        cseq = np.ascontiguousarray(client_seqs, np.int32)
-        ref = np.ascontiguousarray(ref_seqs, np.int32)
-        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
-            raw, self._row_handle[rows], client, cseq, ref,
-            "tree leaves batch")
-        ok = np.flatnonzero(~nacked)
-        if len(ok):
-            rows_ok = rows[ok]
-            # per-doc op position (ops of one doc stay in list order)
-            order = np.argsort(rows_ok, kind="stable")
-            r_sorted = rows_ok[order]
-            starts = np.r_[0, np.flatnonzero(np.diff(r_sorted)) + 1]
-            sizes = np.diff(np.r_[starts, len(r_sorted)])
-            slot_sorted = np.arange(len(r_sorted)) \
-                - np.repeat(starts, sizes)
-            slot = np.empty_like(slot_sorted)
-            slot[order] = slot_sorted
-            take = lambda lst: [lst[i] for i in ok]
-            self.store.apply_flat_inserts(
-                rows_ok, slot, take(parents), take(fields),
-                take(node_ids), take(afters), take(values), take(types),
-                out_seq[ok])
-        ts = self.deli.clock()
-        id_tab = sorted(set(doc_ids))
-        id_of = {d: i for i, d in enumerate(id_tab)}
-        ref_clamped = self._clamped_ref(ref, out_seq)
-        self._append_columnar(ColumnarOps(
-            id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
-                                count=len(ok)),
-            client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
-            out_min[ok], np.zeros(len(ok), np.int32),
-            np.arange(len(ok), dtype=np.int32),
-            np.zeros(len(ok), np.int32),
-            text="", timestamp=ts, family="tree_flat",
-            values=[[parents[i], fields[i], node_ids[i],
-                     afters[i] or "", values[i], types[i]] for i in ok]))
-        for i in ok:
-            self._min_seq[doc_ids[i]] = int(out_min[i])
-        self.metrics.inc("flushes")
-        self.metrics.inc("ops_flushed", n_ok)
-        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
-        return {"seq": out_seq, "nacked": int(nacked.sum())}
-
-    def ingest_batch(self, doc_ids: List[str], clients, client_seqs,
-                     ref_seqs, ops: List[dict]) -> dict:
-        """High-throughput tree ingest: N parallel raw edits (op i targets
-        ``doc_ids[i]``; per-doc order = list order) — ONE native
-        sequencing call, ONE whole-batch durable record (family "tree",
-        the op dicts riding the record's ``values`` table), one batched
-        device apply at flush. Nacked slots are skipped everywhere.
-        Returns {"seq": (N,) int64 (negative = nack code), "nacked"}."""
-        self._check_poisoned()
-        raw = getattr(self.deli, "raw", None)
-        if raw is None:
-            raise RuntimeError("batch ingest requires sequencer='native'")
-        n = len(ops)
-        if not (len(doc_ids) == len(clients) == len(client_seqs)
-                == len(ref_seqs) == n):
-            raise ValueError("batch fields must have equal length")
-        for op in ops:
-            if not self._valid_op(op):
-                raise ValueError(f"malformed tree op {op!r}")
-        if self._graduated and any(d in self._graduated for d in doc_ids):
-            raise ValueError("a targeted doc has graduated off the flat "
-                             "tier; route its ops through submit()")
-        self.flush()  # per-op queue first: per-doc seq order must hold
-        rows = np.fromiter((self.doc_row(d) for d in doc_ids), np.int32,
-                           count=n)
-        self._fill_row_handles(np.unique(rows), raw)
-        t0 = time.perf_counter()
-        handles = self._row_handle[rows]
-        client = np.ascontiguousarray(clients, np.int32)
-        cseq = np.ascontiguousarray(client_seqs, np.int32)
-        ref = np.ascontiguousarray(ref_seqs, np.int32)
-        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
-            raw, handles, client, cseq, ref, "tree batch")
-
-        ok = np.flatnonzero(~nacked)
-        ts = self.deli.clock()
-        msgs = [SequencedDocumentMessage(
-            doc_id=doc_ids[i], client_id=int(client[i]),
-            client_seq=int(cseq[i]),
-            ref_seq=min(int(ref[i]), max(int(out_seq[i]) - 1, 0)),
-            seq=int(out_seq[i]), min_seq=int(out_min[i]),
-            type=MessageType.OP, contents=ops[i], timestamp=ts)
-            for i in ok]
-        # device apply dispatched before the log append (host log work
-        # rides under it), exactly the string pipeline's ordering
-        for m in msgs:
-            self._enqueue(m.doc_id, m)
-            self._min_seq[m.doc_id] = m.min_seq
-        self.flush()
-
-        # ONE whole-batch record: the op dicts ride the values table
-        id_tab = sorted(set(doc_ids))
-        id_of = {d: i for i, d in enumerate(id_tab)}
-        ref_clamped = self._clamped_ref(ref, out_seq)
-        self._append_columnar(ColumnarOps(
-            id_tab, np.fromiter((id_of[doc_ids[i]] for i in ok), np.int32,
-                                count=len(ok)),
-            client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
-            out_min[ok], np.zeros(len(ok), np.int32),
-            np.arange(len(ok), dtype=np.int32),  # a0 → values table
-            np.zeros(len(ok), np.int32),
-            text="", timestamp=ts, family="ops",
-            values=[ops[i] for i in ok],
-            keys=None))
-        self.metrics.inc("flushes")
-        self.metrics.inc("ops_flushed", n_ok)
-        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
-        return {"seq": out_seq, "nacked": int(nacked.sum())}
+        from .tree_wire import _LocalTable, _LocalValues
+        ids_t = _LocalTable(parse_numeric=True)
+        fields_t, types_t = _LocalTable(), _LocalTable()
+        values_t = _LocalValues()
+        recs = np.zeros((n, 8), np.int32)
+        recs[:, 0] = int(TreeOpKind.INSERT_SOLO)
+        recs[:, 1] = np.fromiter((ids_t.handle(x) for x in node_ids),
+                                 np.int32, count=n)
+        recs[:, 2] = np.fromiter((ids_t.handle(x) for x in parents),
+                                 np.int32, count=n)
+        recs[:, 3] = np.fromiter(
+            (ids_t.handle(x) if x else 0 for x in afters),
+            np.int32, count=n)
+        recs[:, 4] = np.fromiter((fields_t.handle(x) for x in fields),
+                                 np.int32, count=n)
+        recs[:, 5] = np.fromiter(
+            (0 if v is None else values_t.handle(v) for v in values),
+            np.int32, count=n)
+        recs[:, 6] = np.fromiter(
+            (0 if t is None else types_t.handle(t) for t in types),
+            np.int32, count=n)
+        batch = {"rec_op": np.arange(n, dtype=np.int64), "recs": recs,
+                 "ids": ids_t.items, "fields": fields_t.items,
+                 "types": types_t.items, "values": values_t.items}
+        return self.ingest_records(doc_ids, clients, client_seqs,
+                                   ref_seqs, batch)
 
     def _store_of(self, doc_id: str):
         """(store, row) owning this doc, post-flush."""
@@ -2309,14 +2759,16 @@ class TreeServingEngine(ServingEngineBase):
         return out
 
     def _doc_log_messages(self, doc_id: str):
-        """Every sequenced OP message for one doc, seq-ascending. Per-op
-        records live in the doc's partition; whole-batch tree records
-        round-robin across partitions (see the string engine)."""
+        """Every sequenced OP message for one doc, seq-ascending, with
+        DECODED dict contents (oracle replay / audit; the state-rebuild
+        path uses ``_doc_log_records`` instead). Per-op records live in
+        the doc's partition; whole-batch records round-robin across
+        partitions (see the string engine)."""
         p_own = partition_of(doc_id, self.log.n_partitions)
         msgs = []
         for p in range(self.log.n_partitions):
             for rec in self.log.read(p):
-                if isinstance(rec, ColumnarOps):
+                if hasattr(rec, "expand"):
                     msgs.extend(rec.expand(only_doc=doc_id))
                 elif p == p_own and rec.doc_id == doc_id \
                         and rec.type == MessageType.OP:
@@ -2324,13 +2776,80 @@ class TreeServingEngine(ServingEngineBase):
         msgs.sort(key=lambda m: m.seq)
         return msgs
 
+    def _doc_log_records(self, doc_id: str):
+        """One doc's full RAW record history as seq-ascending per-op
+        (seq, records) chunks in store-interner handle space.
+        ``TreeRecordOps`` batches contribute their planes bit-identically;
+        per-op dict messages (submit path, legacy log families) re-encode
+        through the canonical emitter."""
+        p_own = partition_of(doc_id, self.log.n_partitions)
+        emitter = self.store.emitter
+        chunks: List[tuple] = []   # (seq, (k,8) global-handle records)
+
+        def add_msg(m):
+            chunks.append((m.seq,
+                           np.array(emitter.emit_op(m.contents), np.int32)))
+
+        for p in range(self.log.n_partitions):
+            for rec in self.log.read(p):
+                if isinstance(rec, TreeRecordOps):
+                    if doc_id not in rec.doc_ids:
+                        continue
+                    want = rec.doc_ids.index(doc_id)
+                    sel = np.flatnonzero(np.asarray(rec.doc) == want)
+                    if not len(sel):
+                        continue
+                    g = self._map_records(
+                        np.ascontiguousarray(rec.recs, np.int32),
+                        {"ids": rec.ids, "fields": rec.fields,
+                         "types": rec.types, "values": rec.values})
+                    starts, ends = rec._op_slices()
+                    for i in sel:
+                        chunks.append((int(rec.seq[i]),
+                                       g[starts[i]:ends[i]]))
+                elif isinstance(rec, ColumnarOps):
+                    for m in rec.expand(only_doc=doc_id):
+                        add_msg(m)
+                elif p == p_own and rec.doc_id == doc_id \
+                        and rec.type == MessageType.OP:
+                    add_msg(rec)
+        chunks.sort(key=lambda c: c[0])
+        return chunks
+
+    _REBUILD_CHUNK = 2048   # bounds the packed scan length per dispatch
+
+    @staticmethod
+    def _chunked_ops(chunks):
+        """Group per-op (seq, recs) chunks into ≤_REBUILD_CHUNK-record
+        apply batches WITHOUT splitting an op: the kernel resets the
+        group flags per apply call, so a transaction's records must land
+        in one batch."""
+        batch: List[tuple] = []
+        size = 0
+        for seq, recs in chunks:
+            if batch and size + len(recs) > TreeServingEngine._REBUILD_CHUNK:
+                yield batch
+                batch, size = [], 0
+            batch.append((seq, recs))
+            size += len(recs)
+        if batch:
+            yield batch
+
+    @staticmethod
+    def _flatten_ops(batch):
+        recs = np.concatenate([c[1] for c in batch])
+        seqs = np.concatenate([np.full(len(c[1]), c[0], np.int64)
+                               for c in batch])
+        return recs, seqs
+
     def _rebuild_doc(self, doc_id: str, start_capacity: int,
                      grow_limit: int):
-        """Replay the doc's full log history into a fresh single-doc store
-        (sharing the batched store's interners so its planes can be adopted
-        verbatim), doubling capacity until it fits."""
+        """Replay the doc's full RAW record history into a fresh
+        single-doc store (sharing the batched store's interners so its
+        planes can be adopted verbatim), doubling capacity until it
+        fits. Chunked applies keep the scan length bounded."""
         from ..ops.tree_store import TensorTreeStore
-        msgs = self._doc_log_messages(doc_id)
+        chunks = self._doc_log_records(doc_id)
         cap = max(start_capacity, 64)
         while True:
             cap *= 2
@@ -2339,10 +2858,92 @@ class TreeServingEngine(ServingEngineBase):
                     f"{doc_id}: rebuild exceeds grow limit {grow_limit}")
             tmp = TensorTreeStore(1, cap)
             tmp.share_interners(self.store)
-            tmp.apply_messages((0, m) for m in msgs)
+            for batch in self._chunked_ops(chunks):
+                recs, seqs = self._flatten_ops(batch)
+                tmp.apply_records(np.zeros(len(recs), np.int64), recs,
+                                  seqs)
             if not tmp.overflowed().any():
                 tmp.repack()   # slot churn must not inflate the fit check
                 return tmp
+
+    def _replay_tail(self, summary: dict, control_hook=None) -> None:
+        """Tree tail replay: raw ``TreeRecordOps`` planes re-apply
+        bit-identically (no decode on the state path); per-op dict
+        messages re-encode through the emitter; everything merges per doc
+        in seq order — the sequencer replays every message in the same
+        order (the r4 partition-scan-order fix)."""
+        items: List[tuple] = []   # (doc_id, seq, msg, raw recs or None)
+        for p in range(self.log.n_partitions):
+            for rec in self.log.read(
+                    p, from_offset=summary["log_offsets"][p]):
+                if isinstance(rec, TreeRecordOps):
+                    g = self._map_records(
+                        np.ascontiguousarray(rec.recs, np.int32),
+                        {"ids": rec.ids, "fields": rec.fields,
+                         "types": rec.types, "values": rec.values})
+                    starts, ends = rec._op_slices()
+                    for i in range(len(rec.seq)):
+                        msg = SequencedDocumentMessage(
+                            doc_id=rec.doc_ids[int(rec.doc[i])],
+                            client_id=int(rec.client[i]),
+                            client_seq=int(rec.client_seq[i]),
+                            ref_seq=int(rec.ref_seq[i]),
+                            seq=int(rec.seq[i]),
+                            min_seq=int(rec.min_seq[i]),
+                            type=MessageType.OP, contents=None,
+                            timestamp=rec.timestamp)
+                        items.append((msg.doc_id, msg.seq, msg,
+                                      g[starts[i]:ends[i]]))
+                elif hasattr(rec, "expand"):
+                    for m in rec.expand():
+                        items.append((m.doc_id, m.seq, m, None))
+                else:
+                    items.append((rec.doc_id, rec.seq, rec, None))
+        items.sort(key=lambda t: (t[0], t[1]))
+        emitter = self.store.emitter
+        flat_ops: List[tuple] = []   # (row, seq, recs) whole ops
+        grad: Dict[str, List[tuple]] = {}
+        for doc_id, seq, msg, raw in items:
+            self.deli.replay(msg)
+            self._record_attribution(msg)
+            if control_hook is not None and control_hook(msg):
+                continue
+            if msg.type != MessageType.OP:
+                continue
+            self._min_seq[doc_id] = max(self._min_seq.get(doc_id, 0),
+                                        msg.min_seq)
+            rl = raw if raw is not None else \
+                np.array(emitter.emit_op(msg.contents), np.int32)
+            if doc_id in self._graduated:
+                grad.setdefault(doc_id, []).append((seq, rl))
+            else:
+                flat_ops.append((self.doc_row(doc_id), seq, rl))
+        # chunked applies at OP boundaries (the kernel resets group flags
+        # per call — a split transaction would lose its gate)
+        batch: List[tuple] = []
+        size = 0
+
+        def apply_flat(batch):
+            rows = np.concatenate([np.full(len(r), row, np.int64)
+                                   for row, _s, r in batch])
+            recs = np.concatenate([r for _row, _s, r in batch])
+            seqs = np.concatenate([np.full(len(r), s, np.int64)
+                                   for _row, s, r in batch])
+            self.store.apply_records(rows, recs, seqs)
+
+        for row, seq, rl in flat_ops:
+            if batch and size + len(rl) > self._REBUILD_CHUNK:
+                apply_flat(batch)
+                batch, size = [], 0
+            batch.append((row, seq, rl))
+            size += len(rl)
+        if batch:
+            apply_flat(batch)
+        for doc_id, parts in grad.items():
+            for gb in self._chunked_ops(parts):
+                recs, seqs = self._flatten_ops(gb)
+                self._graduated[doc_id].apply_records(
+                    np.zeros(len(recs), np.int64), recs, seqs)
 
     def recover_overflowed(self, grow_limit: int = 1 << 16
                            ) -> Dict[str, str]:
@@ -2362,8 +2963,17 @@ class TreeServingEngine(ServingEngineBase):
             else:
                 self.store.clear_doc(row)
                 self._graduated[doc_id] = tmp
+                # return the row AND clear the columnar-ingest caches: a
+                # caller-cached row for this doc now fails loudly in
+                # _fill_row_handles instead of silently sequencing under
+                # a stale doc handle (live vs recovery divergence)
                 self._free_rows.append(self._doc_rows.pop(doc_id))
+                self._row_doc_id[row] = None
+                self._row_handle[row] = -1
                 report[doc_id] = "graduated"
+            # planes rewritten outside the op stream: seq-based dirty
+            # detection would miss the row in the next delta summary
+            self._dirty_outside_ops.add(doc_id)
         # the terminal tier can overflow too: rebuild in place, doubled
         for doc_id, store in list(self._graduated.items()):
             if store.overflowed().any():
@@ -2376,24 +2986,57 @@ class TreeServingEngine(ServingEngineBase):
 
     # ----------------------------------------------------- summary / recovery
 
-    def summarize(self) -> dict:
+    def summarize(self, incremental: bool = False) -> dict:
+        """``incremental=True`` (after one full summary) captures a
+        DELTA: only rows whose doc sequenced an op since the base —
+        detected host-side, no device read — plus rows whose mapping
+        changed or were rewritten by overflow recovery, plus append-only
+        interner deltas. Clean rows ride by reference to the base
+        summary (SURVEY.md §2.16). Graduated single-doc stores snapshot
+        in full (rare tier)."""
         self.flush()
-        summary = self._base_summary()
-        summary["store"] = self.store.snapshot()
-        summary["graduated"] = {d: s.snapshot()
-                                for d, s in self._graduated.items()}
+        prev = self._summ_bookkeeping
+        if self._incremental_ok(incremental):
+            dirty_rows, cur_seqs = self._dirty_rows_since(prev)
+            summary = self._base_summary()
+            summary["kind"] = "delta"
+            summary["base"] = prev["summary"]
+            summary["store_delta"] = self.store.snapshot_rows(
+                sorted(dirty_rows), prev["interner_bases"])
+            summary["graduated"] = {d: s.snapshot()
+                                    for d, s in self._graduated.items()}
+            self._chain_depth += 1
+        else:
+            summary = self._base_summary()
+            summary["kind"] = "full"
+            self._chain_depth = 0
+            summary["store"] = self.store.snapshot()
+            summary["graduated"] = {d: s.snapshot()
+                                    for d, s in self._graduated.items()}
+            cur_seqs = {d: self.deli.doc_seq(d) for d in self._doc_rows}
+        self._note_summary(summary, cur_seqs,
+                           interner_bases=self.store.interner_bases())
         return summary
 
     @classmethod
-    def load(cls, summary: dict, log: PartitionedLog,
+    def load(cls, summary: dict, log: PartitionedLog, mesh=None,
              **kwargs) -> "TreeServingEngine":
         from ..ops.tree_store import TensorTreeStore
-        store = TensorTreeStore.restore(summary["store"])
+        full, deltas = cls.resolve_summary_chain(summary)
+        store = TensorTreeStore.restore(full["store"], mesh=mesh)
+        for delta in deltas:
+            store.apply_row_snapshot(delta["store_delta"])
         engine = cls(store.n_docs, store.capacity, log=log, store=store,
-                     **kwargs)
+                     mesh=mesh, **kwargs)
         engine._restore_base(summary)
         for doc_id, snap in summary["graduated"].items():
-            engine._graduated[doc_id] = TensorTreeStore.restore(snap)
+            grad = TensorTreeStore.restore(snap)
+            # graduated stores alias the batched store's interners at
+            # runtime, so their snapshots exported the SAME tables the
+            # main snapshot did — re-alias so tail records mapped through
+            # the engine's interners mean the same strings here
+            grad.share_interners(engine.store)
+            engine._graduated[doc_id] = grad
         engine._replay_tail(summary)
         engine.flush()
         return engine

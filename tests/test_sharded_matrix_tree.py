@@ -1,0 +1,174 @@
+"""Doc-sharded matrix and tree serving engines (VERDICT r4 missing #3):
+sharded-vs-unsharded parity on the virtual 8-device CPU mesh, recovery
+onto the mesh, and collective-free proofs for the new sharded applies."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.parallel.sharded import make_doc_mesh
+from fluidframework_tpu.server import native_deli
+from fluidframework_tpu.server.serving import (
+    MatrixServingEngine, TreeServingEngine,
+)
+
+from tests.test_tree_kernel import tree_session
+
+pytestmark = pytest.mark.skipif(not native_deli.available(),
+                                reason="native sequencer unavailable")
+
+
+def _tree_pair(n_docs=16):
+    mesh = make_doc_mesh(8)
+    eng = TreeServingEngine(n_docs=n_docs, capacity=256,
+                            batch_window=10 ** 9, sequencer="native",
+                            mesh=mesh)
+    ora = TreeServingEngine(n_docs=n_docs, capacity=256,
+                            batch_window=10 ** 9, sequencer="native")
+    docs = [f"t-{i}" for i in range(n_docs)]
+    for e in (eng, ora):
+        for d in docs:
+            e.connect(d, 1)
+    return mesh, eng, ora, docs
+
+
+def _tree_drive(eng, ora, docs, seeds):
+    per_doc = {d: [m.contents for m in tree_session(s, n_rounds=5)[1]]
+               for d, s in zip(docs, seeds)}
+    w = 0
+    while any(per_doc.values()):
+        ids, ops = [], []
+        for d in docs:
+            if per_doc[d]:
+                ids.append(d)
+                ops.append(per_doc[d].pop(0))
+        for e in (eng, ora):
+            res = e.ingest_batch(ids, [1] * len(ids), [w + 1] * len(ids),
+                                 [0] * len(ids), ops)
+            assert res["nacked"] == 0
+        w += 1
+
+
+def test_sharded_tree_engine_matches_unsharded():
+    mesh, eng, ora, docs = _tree_pair()
+    _tree_drive(eng, ora, docs, range(40, 56))
+    assert np.array_equal(eng.store.digests(), ora.store.digests())
+    for d in docs[::5]:
+        assert eng.to_dict(d) == ora.to_dict(d), d
+    assert "docs" in str(eng.store.state.node_id.sharding.spec)
+
+
+def test_sharded_tree_recovery_onto_mesh():
+    mesh, eng, ora, docs = _tree_pair()
+    _tree_drive(eng, ora, docs, range(60, 76))
+    summary = eng.summarize()
+    # post-summary tail
+    res = eng.ingest_batch(
+        [docs[0]], [1], [eng.deli.doc_seq(docs[0])], [0],
+        [{"op": "insert", "parent": "root", "field": "kids",
+          "after": None, "nodes": [{"id": "tail-node"}]}])
+    revived = TreeServingEngine.load(summary, eng.log, mesh=mesh)
+    for d in docs[::5]:
+        assert revived.to_dict(d) == eng.to_dict(d), d
+    assert "docs" in str(revived.store.state.node_id.sharding.spec)
+
+
+def test_sharded_tree_collective_free():
+    import jax.numpy as jnp
+    from fluidframework_tpu.ops.tree_kernel import TreeState
+    from fluidframework_tpu.parallel.sharded import (
+        shard_tree_store_state, sharded_tree_apply)
+    mesh = make_doc_mesh(8)
+    state = shard_tree_store_state(TreeState.create(16, 64), mesh)
+    planes = jnp.zeros((9, 16, 4), jnp.int32)
+    fn = sharded_tree_apply(mesh)
+    hlo = fn.lower(state, planes).compile().as_text()
+    bad = [op for op in ("all-reduce", "all-gather", "all-to-all",
+                         "collective-permute", "reduce-scatter")
+           if op in hlo]
+    assert not bad, f"sharded tree apply HLO has collectives: {bad}"
+
+
+def _mx_pair(n_docs=16):
+    mesh = make_doc_mesh(8)
+    eng = MatrixServingEngine(n_docs=n_docs, cell_capacity=4096,
+                              batch_window=10 ** 9, sequencer="native",
+                              mesh=mesh)
+    ora = MatrixServingEngine(n_docs=n_docs, cell_capacity=4096,
+                              batch_window=10 ** 9, sequencer="native")
+    docs = [f"x-{i}" for i in range(n_docs)]
+    for e in (eng, ora):
+        for d in docs:
+            e.connect(d, 1)
+    return mesh, eng, ora, docs
+
+
+def _mx_drive(eng, ora, docs, with_fww=False):
+    import random
+    rng = random.Random(7)
+    cseq = {d: 0 for d in docs}
+    for rnd in range(4):
+        for d in docs:
+            ops = [{"mx": "insRow", "pos": 0, "count": 2,
+                    "opKey": [rnd + 1, 0]},
+                   {"mx": "insCol", "pos": 0, "count": 2,
+                    "opKey": [100 + rnd, 0]},
+                   {"mx": "setCell", "row": rng.randrange(2),
+                    "col": rng.randrange(2),
+                    "value": f"{d}-{rnd}"}]
+            if with_fww and rnd == 2:
+                ops.append({"mx": "policy"})
+            if rnd == 3:
+                ops.append({"mx": "rmRow", "start": 0, "count": 1})
+            for op in ops:
+                cseq[d] += 1
+                for e in (eng, ora):
+                    _, nack = e.submit(d, 1, cseq[d], 0, op)
+                    assert nack is None, (d, op, nack)
+        for e in (eng, ora):
+            e.flush()
+
+
+def test_sharded_matrix_engine_matches_unsharded():
+    mesh, eng, ora, docs = _mx_pair()
+    _mx_drive(eng, ora, docs, with_fww=True)
+    for d in docs:
+        assert eng.dims(d) == ora.dims(d), d
+        assert eng.to_lists(d) == ora.to_lists(d), d
+    assert "docs" in str(eng.store.state.key.sharding.spec)
+    assert "docs" in str(eng.axis_store.state.seq.sharding.spec)
+
+
+def test_sharded_matrix_cell_ingest_and_recovery():
+    mesh, eng, ora, docs = _mx_pair()
+    _mx_drive(eng, ora, docs)
+    n = len(docs)
+    res_a = eng.ingest_cells(docs, [1] * n, [14] * n, [0] * n,
+                             [0] * n, [1] * n, [f"v{i}" for i in
+                                                range(n)])
+    res_b = ora.ingest_cells(docs, [1] * n, [14] * n, [0] * n,
+                             [0] * n, [1] * n, [f"v{i}" for i in
+                                                range(n)])
+    assert res_a["nacked"] == res_b["nacked"] == 0
+    for d in docs[::3]:
+        assert eng.to_lists(d) == ora.to_lists(d), d
+    summary = eng.summarize()
+    revived = MatrixServingEngine.load(summary, eng.log, mesh=mesh)
+    for d in docs[::3]:
+        assert revived.to_lists(d) == eng.to_lists(d), d
+
+
+def test_sharded_matrix_incremental_summary():
+    mesh, eng, ora, docs = _mx_pair()
+    _mx_drive(eng, ora, docs)
+    eng.summarize()
+    d0 = docs[0]
+    _, nack = eng.submit(d0, 1, 14, 0, {"mx": "setCell", "row": 0,
+                                        "col": 0, "value": "late"})
+    assert nack is None
+    eng.flush()
+    delta = eng.summarize(incremental=True)
+    assert delta["kind"] == "delta"
+    revived = MatrixServingEngine.load(delta, eng.log, mesh=mesh)
+    assert revived.get_cell(d0, 0, 0) == "late"
+    for d in docs[::3]:
+        assert revived.to_lists(d) == eng.to_lists(d), d

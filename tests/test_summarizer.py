@@ -184,3 +184,47 @@ class TestGarbageCollector:
         assert not fresh.runtime.has_data_store("side")
         assert fresh.runtime.get_data_store("default") \
                     .get_channel("r").get("side") is None
+
+
+class TestChannelHandleReuse:
+    """Channel-handle reuse (SURVEY.md §2.16; VERDICT r4 missing #2):
+    after an acked summary, unchanged channels upload a __handle__ node;
+    the storage service materializes it against the prior summary."""
+
+    def test_one_dirty_channel_of_n_uploads(self):
+        svc, loader, (a, _b), (ma, _mb) = make_doc()
+        ds = a.runtime.create_data_store("default")
+        chans = [ds.create_channel(f"c{i}", "map") for i in range(8)]
+        for i, ch in enumerate(chans):
+            ch.set("k", i)
+        ma.summarize_now()
+        assert ma.summaries_acked == 1
+        chans[3].set("k", 99)  # ONE dirty channel of 8
+        tree = a.runtime.summarize(incremental=True)
+        entries = tree["datastores"]["default"]["channels"]
+        handles = [cid for cid, ch in entries.items()
+                   if "__handle__" in ch]
+        assert len(handles) == 7 and "c3" not in handles
+        # the storage-resolved upload restores every channel's content
+        ma.summarize_now()
+        assert ma.summaries_acked == 2
+        stored, _seq, _sha = svc.historian.latest_summary("doc")
+        ch_stored = stored["runtime"]["datastores"]["default"]["channels"]
+        assert all("__handle__" not in ch for ch in ch_stored.values())
+        fresh = loader.resolve("doc")
+        fds = fresh.runtime.get_data_store("default")
+        for i in range(8):
+            want = 99 if i == 3 else i
+            assert fds.get_channel(f"c{i}").get("k") == want, i
+
+    def test_handle_upload_is_smaller(self):
+        import json
+        svc, _loader, (a, _b), (ma, _mb) = make_doc()
+        ds = a.runtime.create_data_store("default")
+        for i in range(16):
+            ds.create_channel(f"c{i}", "map").set("payload", "x" * 1000)
+        ma.summarize_now()
+        full_bytes = len(json.dumps(a.runtime.summarize(run_gc=False)))
+        inc_bytes = len(json.dumps(
+            a.runtime.summarize(run_gc=False, incremental=True)))
+        assert inc_bytes < full_bytes / 5

@@ -1,0 +1,271 @@
+"""The tree columnar record wire format (server/tree_wire.py):
+encode→decode round-trips, ingest_records vs per-op submit parity,
+durable TreeRecordOps codec, raw-plane recovery, and bounds rejection."""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.shared_tree import SharedTree
+from fluidframework_tpu.server.serving import (
+    TreeRecordOps, TreeServingEngine,
+)
+from fluidframework_tpu.server.tree_wire import (
+    TreeBatchEncoder, decode_op, encode_tree_batch,
+)
+
+from tests.test_tree_kernel import tree_session
+
+
+def _normalize(op):
+    """Encoder-canonical form of an op dict: every spec carries explicit
+    type/value keys; a constraint-free one-edit transaction is its edit."""
+    kind = op["op"]
+    if kind == "insert":
+        def norm_spec(s):
+            out = {"id": s["id"], "type": s.get("type"),
+                   "value": s.get("value")}
+            kids = {f: [norm_spec(c) for c in cs]
+                    for f, cs in (s.get("children") or {}).items() if cs}
+            if kids:
+                out["children"] = kids
+            return out
+        return {"op": "insert", "parent": op["parent"],
+                "field": op["field"], "after": op.get("after"),
+                "nodes": [norm_spec(s) for s in op["nodes"]]}
+    if kind == "transaction":
+        cons = [c for c in op.get("constraints", ())]
+        edits = [_normalize(e) for e in op["edits"]]
+        if not cons and len(edits) == 1 and edits[0]["op"] == "insert":
+            return edits[0]
+        out = {"op": "transaction", "edits": edits}
+        if cons:
+            out["constraints"] = cons
+        return out
+    if kind == "move":
+        return {"op": "move", "id": op["id"], "parent": op["parent"],
+                "field": op["field"], "after": op.get("after")}
+    return dict(op)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_encode_decode_round_trip_fuzz(seed):
+    """decode(encode(op)) ≡ op (canonical form) over the fuzz corpus."""
+    _, msgs = tree_session(seed)
+    ops = [m.contents for m in msgs]
+    enc = TreeBatchEncoder()
+    for op in ops:
+        enc.add(op)
+    b = enc.batch()
+    rec_op = np.asarray(b["rec_op"])
+    for i, op in enumerate(ops):
+        sel = np.flatnonzero(rec_op == i)
+        recs = [tuple(int(v) for v in b["recs"][j]) for j in sel]
+        got = decode_op(recs, b["ids"], b["fields"], b["types"],
+                        b["values"])
+        assert _normalize(got) == _normalize(op), f"op {i}"
+
+
+def test_decode_preserves_multinode_and_nested():
+    op = {"op": "insert", "parent": "root", "field": "kids",
+          "after": "anchor",
+          "nodes": [
+              {"id": "a", "type": "t", "value": 1,
+               "children": {"f1": [{"id": "a1", "type": None,
+                                    "value": None},
+                                   {"id": "a2", "type": "u",
+                                    "value": [1, 2]}],
+                            "f2": [{"id": "a3", "type": None,
+                                    "value": "x"}]}},
+              {"id": "b", "type": None, "value": None}]}
+    b = encode_tree_batch([op, {"op": "insert", "parent": "root",
+                                "field": "kids", "after": "anchor",
+                                "nodes": [{"id": "c"}]}])
+    rec_op = np.asarray(b["rec_op"])
+    sel = np.flatnonzero(rec_op == 0)
+    recs = [tuple(int(v) for v in b["recs"][j]) for j in sel]
+    got = decode_op(recs, b["ids"], b["fields"], b["types"], b["values"])
+    assert _normalize(got) == _normalize(op)
+
+
+def _mk(n_docs=6):
+    eng = TreeServingEngine(n_docs=n_docs, capacity=256,
+                            batch_window=10 ** 9, sequencer="native")
+    docs = [f"d{i}" for i in range(n_docs)]
+    for d in docs:
+        eng.connect(d, 1)
+    return eng, docs
+
+
+def _fuzz_waves(docs, seeds):
+    """Per-doc fuzz sessions re-cut into cross-doc ingest waves."""
+    per_doc = {d: [m.contents for m in tree_session(s, n_rounds=6)[1]]
+               for d, s in zip(docs, seeds)}
+    waves = []
+    w = 0
+    while any(per_doc.values()):
+        ids, ops = [], []
+        for d in docs:
+            if per_doc[d]:
+                ids.append(d)
+                ops.append(per_doc[d].pop(0))
+        waves.append((ids, ops))
+        w += 1
+    return waves
+
+
+def test_ingest_records_matches_per_op_submit():
+    """The columnar record path and the per-op submit path produce the
+    same trees for the same op streams (fuzz corpus incl. transactions,
+    nested inserts, moves, removes)."""
+    eng_a, docs = _mk()
+    eng_b, _ = _mk()
+    waves = _fuzz_waves(docs, range(10, 16))
+    for w, (ids, ops) in enumerate(waves):
+        cseq = [w + 1] * len(ids)
+        res = eng_a.ingest_batch(ids, [1] * len(ids), cseq,
+                                 [0] * len(ids), ops)
+        assert res["nacked"] == 0
+        for d, op in zip(ids, ops):
+            _, nack = eng_b.submit(d, 1, w + 1, 0, op)
+            assert nack is None
+    for d in docs:
+        assert eng_a.to_dict(d) == eng_b.to_dict(d), d
+
+
+def test_ingest_records_oracle_parity_and_log_replay():
+    eng, docs = _mk()
+    waves = _fuzz_waves(docs, range(20, 26))
+    for w, (ids, ops) in enumerate(waves):
+        eng.ingest_batch(ids, [1] * len(ids), [w + 1] * len(ids),
+                         [0] * len(ids), ops)
+    for d in docs[:3]:
+        oracle = SharedTree(d, 999)
+        for m in eng._doc_log_messages(d):
+            oracle.process_core(m, local=False)
+        assert eng.to_dict(d) == oracle.to_dict(), d
+
+
+def test_tree_records_summary_tail_recovery():
+    """Raw-plane tail replay: summary mid-stream, more record batches,
+    then load() rebuilds the same trees (and sequencing continues)."""
+    eng, docs = _mk()
+    waves = _fuzz_waves(docs, range(30, 36))
+    cut = len(waves) // 2
+    for w, (ids, ops) in enumerate(waves[:cut]):
+        eng.ingest_batch(ids, [1] * len(ids), [w + 1] * len(ids),
+                         [0] * len(ids), ops)
+    summary = eng.summarize()
+    for w, (ids, ops) in enumerate(waves[cut:]):
+        eng.ingest_batch(ids, [1] * len(ids), [cut + w + 1] * len(ids),
+                         [0] * len(ids), ops)
+    want = {d: eng.to_dict(d) for d in docs}
+    revived = TreeServingEngine.load(summary, eng.log)
+    assert {d: revived.to_dict(d) for d in docs} == want
+    # sequencing resumes past the tail: a fresh op lands, same on both
+    n_sent = sum(1 for ids, _ in waves if docs[0] in ids)
+    op = {"op": "insert", "parent": "root", "field": "kids",
+          "after": None, "nodes": [{"id": "fresh", "type": None,
+                                    "value": 7}]}
+    for e in (eng, revived):
+        r = e.ingest_batch([docs[0]], [1], [n_sent + 1], [0], [op])
+        assert r["nacked"] == 0
+    assert revived.to_dict(docs[0]) == eng.to_dict(docs[0])
+
+
+def test_tree_records_nacks_drop_records_everywhere():
+    eng, docs = _mk()
+    d0, d1 = docs[0], docs[1]
+    # clientSeq gap on the middle op: its records must not apply nor log
+    res = eng.ingest_batch(
+        [d0, d0, d1], [1] * 3, [1, 99, 1], [0] * 3,
+        [{"op": "insert", "parent": "root", "field": "kids",
+          "after": None, "nodes": [{"id": "x0"}]},
+         {"op": "insert", "parent": "root", "field": "kids",
+          "after": None, "nodes": [{"id": "x1"}]},
+         {"op": "insert", "parent": "root", "field": "kids",
+          "after": None, "nodes": [{"id": "y0"}]}])
+    assert res["nacked"] == 1 and res["seq"][1] < 0
+    assert eng.has_node(d0, "x0") and not eng.has_node(d0, "x1")
+    assert eng.has_node(d1, "y0")
+    # the durable record kept only the acked ops
+    msgs = eng._doc_log_messages(d0)
+    assert [m.contents["nodes"][0]["id"] for m in msgs] == ["x0"]
+    # and recovery agrees
+    revived = TreeServingEngine.load(eng.summarize(), eng.log)
+    assert revived.to_dict(d0) == eng.to_dict(d0)
+
+
+def test_malformed_record_batches_rejected_before_sequencing():
+    eng, docs = _mk()
+    d = docs[0]
+    seq_before = eng.deli.doc_seq(d)
+    base = {"rec_op": np.zeros(1, np.int64),
+            "recs": np.zeros((1, 8), np.int32),
+            "ids": ["n"], "fields": ["f"], "types": [], "values": []}
+
+    def bad(**kw):
+        b = dict(base)
+        b.update(kw)
+        return b
+
+    recs_badkind = np.zeros((1, 8), np.int32)
+    recs_badkind[0, 0] = 99
+    with pytest.raises(ValueError, match="kind out of range"):
+        eng.ingest_records([d], [1], [1], [0], bad(recs=recs_badkind))
+    recs_badnode = np.zeros((1, 8), np.int32)
+    recs_badnode[0, 0] = 9   # INSERT_SOLO
+    recs_badnode[0, 1] = 5   # out of ids table
+    with pytest.raises(ValueError, match="node handle"):
+        eng.ingest_records([d], [1], [1], [0], bad(recs=recs_badnode))
+    with pytest.raises(ValueError, match="rec_op"):
+        eng.ingest_records([d], [1], [1], [0],
+                           bad(rec_op=np.asarray([3], np.int64)))
+    with pytest.raises(ValueError, match="non-empty str"):
+        eng.ingest_records([d], [1], [1], [0], bad(ids=[""]))
+    with pytest.raises(ValueError, match="unserializable"):
+        eng.ingest_records([d], [1], [1], [0], bad(values=[set()]))
+    assert eng.deli.doc_seq(d) == seq_before
+    eng.summarize()   # not poisoned
+
+
+def test_tree_records_native_log_round_trip(tmp_path):
+    from fluidframework_tpu.server import native_oplog
+    if not native_oplog.available():
+        pytest.skip("native oplog unavailable")
+    rec = TreeRecordOps(
+        doc_ids=["a", "b"], doc=np.array([0, 1, 0], np.int64),
+        client=np.array([1, 2, 1], np.int64),
+        client_seq=np.array([1, 1, 2], np.int64),
+        ref_seq=np.array([0, 0, 1], np.int64),
+        seq=np.array([2, 2, 3], np.int64),
+        min_seq=np.array([0, 0, 0], np.int64),
+        rec_op=np.array([0, 1, 1, 2], np.int64),
+        recs=np.array([[9, 1, 2, 0, 1, 0, 0, 0],
+                       [3, 0, 0, 0, 0, 0, 0, 0],
+                       [8, 1, 0, 0, 0, 1, 0, 0],
+                       [10, 1, 0, 0, 0, 0, 0, 0]], np.int32),
+        ids=["n1", "root"], fields=["kids"], types=[],
+        values=[{"deep": [1, None]}], timestamp=123.5)
+    log = native_oplog.NativePartitionedLog(str(tmp_path), 2)
+    log.append(1, rec)
+    got = next(iter(log.read(1)))
+    log.close()
+    assert isinstance(got, TreeRecordOps)
+    assert got.doc_ids == rec.doc_ids and got.ids == rec.ids
+    assert got.fields == rec.fields and got.values == rec.values
+    assert got.timestamp == rec.timestamp
+    for f in ("doc", "client", "client_seq", "ref_seq", "seq", "min_seq",
+              "rec_op"):
+        assert np.array_equal(getattr(got, f), getattr(rec, f)), f
+    assert np.array_equal(got.recs, rec.recs)
+
+
+def test_nested_transaction_rejected():
+    eng, docs = _mk()
+    nested = {"op": "transaction", "edits": [
+        {"op": "transaction", "edits": [
+            {"op": "setValue", "id": "root", "value": 1}]}]}
+    _, nack = eng.submit(docs[0], 1, 1, 0, nested)
+    assert nack is not None
+    with pytest.raises(ValueError, match="malformed"):
+        eng.ingest_batch([docs[0]], [1], [1], [0], [nested])
