@@ -28,7 +28,74 @@ re-prints the child's final JSON line.
 import json
 import subprocess
 import sys
+import threading
 import time
+
+
+class RttMonitor:
+    """Continuous tunnel-RTT sampler on a background thread.
+
+    The phase-boundary snapshots (``rtt_phases``) can only say "the
+    tunnel was slow at SOME point in this phase"; a transient stall
+    inside a timed section is invisible there yet silently inflates that
+    phase's number. This thread dispatches one tiny jitted tick + D2H
+    read every ``interval`` seconds for the whole run — its own device
+    buffer, never shared with foreground phases — and records every
+    sample. Samples past ``stall_factor`` × the starting baseline (with
+    an absolute floor) become stall EVENTS with run-relative timestamps,
+    so contention windows land in the bench record itself."""
+
+    def __init__(self, baseline_ms: float, interval: float = 0.5,
+                 stall_factor: float = 3.0, floor_ms: float = 250.0,
+                 keep_events: int = 64):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        self._np = np
+        self._tick = jax.jit(lambda v: v + 1)
+        self._buf = self._tick(jnp.zeros((1,), jnp.int32))
+        _ = np.asarray(self._buf)  # compile outside the sampling loop
+        self.interval = interval
+        self.threshold_ms = max(stall_factor * baseline_ms, floor_ms)
+        self.keep_events = keep_events
+        self.samples_ms: list = []
+        self.stall_events: list = []
+        self._stop = threading.Event()
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> "RttMonitor":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            tb = time.perf_counter()
+            self._buf = self._tick(self._buf)
+            _ = self._np.asarray(self._buf)
+            ms = (time.perf_counter() - tb) * 1000
+            self.samples_ms.append(ms)
+            if ms > self.threshold_ms and \
+                    len(self.stall_events) < self.keep_events:
+                self.stall_events.append(
+                    {"at_s": round(time.perf_counter() - self._t0, 1),
+                     "ms": round(ms, 1)})
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def summary(self) -> dict:
+        s = sorted(self.samples_ms)
+        if not s:
+            return {"n": 0}
+        return {"n": len(s),
+                "p50_ms": round(s[len(s) // 2], 1),
+                "p95_ms": round(s[int(len(s) * 0.95)], 1),
+                "max_ms": round(s[-1], 1),
+                "threshold_ms": round(self.threshold_ms, 1),
+                "stalls": self.stall_events}
 
 
 def run():
@@ -136,6 +203,10 @@ def run():
 
     rtt_ms = rtt_now()
     rtt_phases = {"start": round(rtt_ms, 1)}
+    # continuous canary: samples the tunnel for the WHOLE run so stalls
+    # inside timed sections (invisible to the phase-boundary snapshots)
+    # show up as dated events in the record
+    rtt_monitor = RttMonitor(baseline_ms=rtt_ms).start()
     import os as _os
     load_start = _os.getloadavg()[0]
 
@@ -841,6 +912,7 @@ def run():
         samples.append(time.perf_counter() - tb)
     worst_ms = float(max(samples) * 1000 / ops_per_batch)
 
+    rtt_monitor.stop()
     print(json.dumps({
         "metric": "sharedstring_ops_per_sec_merged",
         "value": round(ops_per_sec, 1),
@@ -864,10 +936,14 @@ def run():
         # boundaries + host load; inflated values mean the phase numbers
         # ran under contention (read medians, not bests)
         "rtt_phases": rtt_phases,
+        # whole-run RTT distribution + dated stall events from the
+        # background sampler (see RttMonitor)
+        "rtt_monitor": rtt_monitor.summary(),
         "host_load_start_end": [round(load_start, 2),
                                 round(_os.getloadavg()[0], 2)],
         "contended": bool(max(rtt_phases.values()) >
-                          2 * max(rtt_phases["start"], 60.0)),
+                          2 * max(rtt_phases["start"], 60.0)
+                          or bool(rtt_monitor.stall_events)),
         # host-side wall per ingest batch, by stage (p50; device time is
         # the remainder of the batch wall — it overlaps the next batch's
         # host work): C++ sequencing / plane prep / wire packing / async

@@ -306,7 +306,9 @@ def _range_one(s, kind, start, end_pos, packed, seq, client_idx, ref_seq,
     pre, endp = _prefix(s, vis)
     target = vis & (pre >= start) & (endp <= end_pos) & (s["length"] > 0)
 
-    is_rem = kind == OpKind.STR_REMOVE
+    # int(): IntEnum members are not literal-eligible on older jax (exact-
+    # type check) and become captured constants, which pallas<0.5 rejects
+    is_rem = kind == int(OpKind.STR_REMOVE)
     bit = jnp.where(client_idx >= 0,
                     (1 << jnp.clip(client_idx, 0, MAX_CLIENTS - 1)), 0)
     out = dict(s)
@@ -319,7 +321,7 @@ def _range_one(s, kind, start, end_pos, packed, seq, client_idx, ref_seq,
     if with_props:
         key_idx = packed >> PROP_HANDLE_BITS
         handle = packed & ((1 << PROP_HANDLE_BITS) - 1)
-        is_ann = target & (kind == OpKind.STR_ANNOTATE)
+        is_ann = target & (kind == int(OpKind.STR_ANNOTATE))
         for ki, pk in enumerate(_prop_keys(s)):
             out[pk] = jnp.where(is_ann & (key_idx == ki), handle, s[pk])
         if "prop_val" in s:  # stacked (S, K) variant (megadoc XLA path)

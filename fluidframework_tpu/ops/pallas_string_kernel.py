@@ -139,9 +139,12 @@ def _kernel(*refs, compact: bool, n_props: int):
 
         def pick(key):
             tail = (1,) * (c[key].ndim - 1)
-            is_ins = (k == OpKind.STR_INSERT).reshape((-1,) + tail)
-            is_rng = ((k == OpKind.STR_REMOVE) |
-                      (k == OpKind.STR_ANNOTATE)).reshape((-1,) + tail)
+            # int(): IntEnum members are not literal-eligible on older jax
+            # (exact-type check) and would be captured as kernel constants,
+            # which pallas<0.5 rejects
+            is_ins = (k == int(OpKind.STR_INSERT)).reshape((-1,) + tail)
+            is_rng = ((k == int(OpKind.STR_REMOVE)) |
+                      (k == int(OpKind.STR_ANNOTATE))).reshape((-1,) + tail)
             return jnp.where(is_ins, ins[key],
                              jnp.where(is_rng, rng[key], c[key]))
 

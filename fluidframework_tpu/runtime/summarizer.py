@@ -35,6 +35,7 @@ import time
 from typing import Callable, Optional
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..utils.faultpoints import SITE_SUMMARIZER_POST_UPLOAD, fault_point
 
 
 @dataclasses.dataclass
@@ -173,6 +174,11 @@ class SummaryManager:
         self._inflight_capture = container.runtime.take_summary_capture()
         handle = container.service.summary_storage.upload_summary(
             summary, seq)
+        # crash here = summary uploaded but the SUMMARIZE proposal never
+        # sequenced: the upload is an orphan blob, no ack ever references
+        # it, and a restarted summarizer must re-propose from the last
+        # ACKED summary (never resume this one)
+        fault_point(SITE_SUMMARIZER_POST_UPLOAD, seq=seq, handle=handle)
         # mark in-flight BEFORE submit: the synchronous local pipeline
         # processes the echo (which records pending_proposal) and the ack
         # reentrantly inside this call

@@ -37,6 +37,7 @@ import json
 import socket
 import struct
 import threading
+import time
 import zlib
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -387,11 +388,35 @@ class ColumnarAlfred:
             self._thread.join(timeout=5)
 
 
+def connect_with_backoff(host: str, port: int, attempts: int = 5,
+                         base_delay: float = 0.05,
+                         timeout: Optional[float] = None) -> socket.socket:
+    """``socket.create_connection`` with BOUNDED exponential backoff.
+
+    A server restarting after a crash drill (or still binding) refuses
+    connections for a beat; one retry loop here beats N ad-hoc sleeps in
+    callers. Bounded: after ``attempts`` failures the last error
+    propagates — an ingress that is actually down must fail loudly, not
+    hang."""
+    last_err: Optional[Exception] = None
+    for i in range(attempts):
+        try:
+            return socket.create_connection((host, port), timeout=timeout)
+        except OSError as e:
+            last_err = e
+            if i < attempts - 1:
+                time.sleep(base_delay * (2 ** i))
+    raise ConnectionError(
+        f"columnar ingress {host}:{port} unreachable after "
+        f"{attempts} attempts") from last_err
+
+
 class ColumnarClient:
     """Blocking-socket client for the columnar ingress (tests/bench)."""
 
-    def __init__(self, host: str, port: int):
-        self.sock = socket.create_connection((host, port))
+    def __init__(self, host: str, port: int, connect_attempts: int = 5):
+        self.sock = connect_with_backoff(host, port,
+                                         attempts=connect_attempts)
         self.client_id: Optional[int] = None
         self.rows: Dict[str, int] = {}
 

@@ -18,6 +18,8 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..utils.atomicfile import atomic_write_json, read_json
+from ..utils.faultpoints import SITE_DELI_MID_WINDOW, fault_point
 
 
 class NackReason(enum.IntEnum):
@@ -133,6 +135,10 @@ class DeliSequencer:
         client.ref_seq = max(client.ref_seq, ref_seq)
         doc.seq += 1
         doc.min_seq = doc.compute_msn()
+        # crash here = op stamped but never published/logged: a restarted
+        # partition (checkpoint + deltas replay) must re-issue this seq
+        # to the client's resend, not skip it
+        fault_point(SITE_DELI_MID_WINDOW, doc_id=doc_id, seq=doc.seq)
         msg = SequencedDocumentMessage(
             doc_id=doc_id, client_id=client_id, client_seq=client_seq,
             ref_seq=ref_seq, seq=doc.seq, min_seq=doc.min_seq, type=type,
@@ -165,6 +171,16 @@ class DeliSequencer:
                 doc.clients[int(cid)] = _ClientState(lcs, rs)
             deli._docs[doc_id] = doc
         return deli
+
+    def save_checkpoint(self, path: str) -> None:
+        """Durable checkpoint: tmp + fsync + rename, so a kill mid-write
+        can never destroy the previous checkpoint (the only recovery
+        anchor a restarted partition has)."""
+        atomic_write_json(path, self.checkpoint())
+
+    @classmethod
+    def load_checkpoint(cls, path: str, clock=None) -> "DeliSequencer":
+        return cls.restore(read_json(path), clock=clock)
 
     def doc_seq(self, doc_id: str) -> int:
         return self._doc(doc_id).seq

@@ -170,9 +170,20 @@ class AlfredServer:
         self.evictions = 0  # slow-client disconnects (observability)
         self._server: Optional[asyncio.AbstractServer] = None
 
-    async def start(self) -> None:
-        self._server = await asyncio.start_server(
-            self._accept, self.host, self.port)
+    async def start(self, bind_attempts: int = 5,
+                    base_delay: float = 0.05) -> None:
+        # bounded bind retry: a fixed port vacated by a crashed
+        # predecessor can linger in TIME_WAIT for a beat; an ephemeral
+        # port (0) binds first try and skips the loop entirely
+        for i in range(bind_attempts):
+            try:
+                self._server = await asyncio.start_server(
+                    self._accept, self.host, self.port)
+                break
+            except OSError:
+                if i == bind_attempts - 1:
+                    raise
+                await asyncio.sleep(base_delay * (2 ** i))
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def _accept(self, reader, writer) -> None:

@@ -254,3 +254,14 @@ class NativeDeliAdapter:
         return cls(clock=clock,
                    _native=NativeDeli.restore(
                        snapshot["native"].encode("latin1")))
+
+    def save_checkpoint(self, path: str) -> None:
+        """Atomic (tmp + fsync + rename) durable checkpoint — a kill
+        mid-write leaves the previous checkpoint file intact."""
+        from ..utils.atomicfile import atomic_write_json
+        atomic_write_json(path, self.checkpoint())
+
+    @classmethod
+    def load_checkpoint(cls, path: str, clock=None) -> "NativeDeliAdapter":
+        from ..utils.atomicfile import read_json
+        return cls.restore(read_json(path), clock=clock)

@@ -7,6 +7,14 @@ Here: an in-process partitioned log with optional JSONL spill to disk, used
 as (a) the raw-ops ingress queue, (b) the sequenced-deltas stream feeding
 broadcaster/scriptorium/scribe, and (c) the recovery source (a restarted
 lambda re-reads from its checkpointed offset).
+
+Recovery (``PartitionedLog.recover``) tolerates a TORN TAIL: a crash mid-
+write leaves the last JSONL line truncated; recovery skips it, truncates
+the file back to the last complete record, and continues — the same
+semantics as the native log's CRC-checked tail truncation
+(``native_oplog``). An op lost to a torn tail was by construction never
+acked (``append`` returns — and the caller acks — only after the line is
+fully written and flushed).
 """
 
 from __future__ import annotations
@@ -15,9 +23,13 @@ import dataclasses
 import json
 import os
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from ..utils.faultpoints import (
+    SITE_OPLOG_MID_APPEND, SITE_OPLOG_MID_SPILL, fault_point,
+)
 
 
 def _spill_json(o):
@@ -33,6 +45,37 @@ def _spill_json(o):
     return str(o)
 
 
+def _spill_decode(obj: Any) -> Any:
+    """Revive a spilled record: ``__type__``-tagged dicts become their
+    dataclasses again (array fields back to np arrays, enum fields back
+    to enums) so a recovered log replays through the same code paths as
+    the in-memory one."""
+    if not (isinstance(obj, dict) and "__type__" in obj):
+        return obj
+    kind = obj.pop("__type__")
+    if kind == "SequencedDocumentMessage":
+        from ..core.protocol import MessageType, SequencedDocumentMessage
+        obj["type"] = MessageType(obj["type"])
+        return SequencedDocumentMessage(**obj)
+    if kind == "ColumnarOps":
+        from .serving import ColumnarOps
+        for k in ("doc", "client", "client_seq", "ref_seq", "seq",
+                  "min_seq", "kind", "a0", "a1"):
+            obj[k] = np.asarray(obj[k], np.int64)
+        if obj.get("tidx") is not None:
+            obj["tidx"] = np.asarray(obj["tidx"], np.int64)
+        return ColumnarOps(**obj)
+    if kind == "TreeRecordOps":
+        from .serving import TreeRecordOps
+        for k in ("doc", "client", "client_seq", "ref_seq", "seq",
+                  "min_seq", "rec_op"):
+            obj[k] = np.asarray(obj[k], np.int64)
+        obj["recs"] = np.asarray(obj["recs"], np.int32)
+        return TreeRecordOps(**obj)
+    obj["__type__"] = kind  # unknown dataclass: keep the tagged dict
+    return obj
+
+
 def partition_of(doc_id: str, n_partitions: int) -> int:
     """Stable doc → partition mapping (document-level parallelism axis)."""
     h = 2166136261
@@ -41,10 +84,43 @@ def partition_of(doc_id: str, n_partitions: int) -> int:
     return h % n_partitions
 
 
+def _read_spill_tolerant(path: str) -> Tuple[List[Any], int, bool]:
+    """Parse one partition's JSONL spill. Returns (records, byte offset
+    of the end of the last COMPLETE record, whether a torn tail was
+    dropped). A decode failure on any line but the last is real
+    corruption (not a crash artifact) and raises."""
+    records: List[Any] = []
+    good_end = 0
+    torn = False
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    # data ending in "\n" yields a trailing b"" — complete final record;
+    # anything else in the last slot is a torn tail candidate
+    for i, line in enumerate(lines):
+        last = i == len(lines) - 1
+        if last and line == b"":
+            break
+        try:
+            records.append(
+                _spill_decode(json.loads(line.decode("utf-8"))))
+            good_end += len(line) + 1
+        except (ValueError, UnicodeDecodeError):
+            if not last:
+                raise ValueError(
+                    f"corrupt spill record mid-file in {path} "
+                    f"(line {i + 1}): not a crash torn-tail")
+            torn = True
+            break
+    return records, good_end, torn
+
+
 class PartitionedLog:
     def __init__(self, n_partitions: int = 8,
                  spill_dir: Optional[str] = None, name: str = "log"):
         self.n_partitions = n_partitions
+        self.spill_dir = spill_dir
+        self.name = name
         self._parts: List[List[Any]] = [[] for _ in range(n_partitions)]
         self._subs: List[List[Callable[[int, int, Any], None]]] = [
             [] for _ in range(n_partitions)]
@@ -62,6 +138,30 @@ class PartitionedLog:
                 for i in range(n_partitions)
             ]
 
+    @classmethod
+    def recover(cls, n_partitions: int, spill_dir: str,
+                name: str = "log") -> "PartitionedLog":
+        """Rebuild a log from its JSONL spill after a crash. Torn tails
+        (partial last line from a mid-write kill) are dropped and the
+        file truncated back to the last complete record, so subsequent
+        appends continue a clean stream — matching ``native_oplog``'s
+        CRC tail truncation. Returns a log with spill re-attached."""
+        records: List[List[Any]] = []
+        for i in range(n_partitions):
+            path = os.path.join(spill_dir, f"{name}-p{i}.jsonl")
+            if not os.path.exists(path):
+                records.append([])
+                continue
+            recs, good_end, torn = _read_spill_tolerant(path)
+            if torn:
+                with open(path, "r+b") as f:
+                    f.truncate(good_end)
+            records.append(recs)
+        log = cls(n_partitions, spill_dir, name)
+        for i, recs in enumerate(records):
+            log._parts[i] = recs
+        return log
+
     def append(self, partition: int, record: Any) -> int:
         """Append; returns the record's offset. Notifies subscribers inline,
         in offset order (in-process stand-in for the consumer poll loop)."""
@@ -69,9 +169,18 @@ class PartitionedLog:
             part = self._parts[partition]
             offset = len(part)
             part.append(record)
+            # crash here = record in memory, nothing durable, NOT acked
+            fault_point(SITE_OPLOG_MID_APPEND, partition=partition,
+                        offset=offset)
             if self._spill is not None:
-                self._spill[partition].write(
-                    json.dumps(record, default=_spill_json) + "\n")
+                line = json.dumps(record, default=_spill_json) + "\n"
+                # crash mid-line = the torn tail recovery must tolerate;
+                # an armed plan may ask for a partial write (realistic
+                # kill between write syscalls)
+                fault_point(SITE_OPLOG_MID_SPILL, partition=partition,
+                            offset=offset, line=line,
+                            fh=self._spill[partition])
+                self._spill[partition].write(line)
                 self._spill[partition].flush()
             for fn in list(self._subs[partition]):
                 fn(partition, offset, record)

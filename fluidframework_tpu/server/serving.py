@@ -31,7 +31,11 @@ import numpy as np
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..runtime.attributor import Attributor
-from ..utils.telemetry import MetricsCollector
+from ..utils.faultpoints import (
+    SITE_APPLY_STALL, SITE_FLUSH_MID_BATCH, SITE_INGEST_MID_BATCH,
+    SITE_SUBMIT_POST_SEQUENCE, fault_point,
+)
+from ..utils.telemetry import MetricsCollector, TelemetryLogger
 from ..ops.map_kernel import TensorMapStore
 from ..ops.schema import OpKind
 from ..ops.string_store import TensorStringStore
@@ -240,6 +244,16 @@ class ServingEngineBase:
         # per-lambda observability (SURVEY.md §5.5: op rate, nacks by
         # reason, flush batch sizes, flush latency percentiles)
         self.metrics = MetricsCollector()
+        # structured events (attach a sink via telemetry._sink or replace
+        # the logger); the apply watchdog warns through it
+        self.telemetry = TelemetryLogger(None, "serving")
+        # apply watchdog: a device apply that takes longer than this is a
+        # STALL — counted, recorded (bounded ring), and warned, so a 63 s
+        # hiccup shows up in telemetry instead of vanishing into an
+        # average (round-5 postmortem: one unattributed 983 ms worst)
+        self.stall_threshold_ms = 250.0
+        self.stall_events: List[dict] = []   # most recent _STALL_KEEP
+        self._STALL_KEEP = 64
         # round-robin partition cursor for whole-batch columnar records
         # (see _append_columnar)
         self._col_part = 0
@@ -383,6 +397,9 @@ class ServingEngineBase:
         out_seq, out_min = raw.sequence_batch_rows(
             handles, client, client_seq, ref_seq)
         self._poisoned = f"{what} failed after sequencing"
+        # crash here = batch sequenced, nothing durable, nothing acked; a
+        # restarted engine (summary + log tail) must never see these seqs
+        fault_point(SITE_INGEST_MID_BATCH, what=what)
         nacked = out_seq < 0
         n_ok = int((~nacked).sum())
         self.metrics.inc("ops_ingested", n_ok)
@@ -446,6 +463,10 @@ class ServingEngineBase:
             self._unadmit(doc_id, contents)
             return self._nacked(nack)
         self.metrics.inc("ops_ingested")
+        # crash here = sequenced but never logged: the op was NOT acked
+        # (submit didn't return), so recovery may drop it — but sequencer
+        # counters restored from the log must stay monotone regardless
+        fault_point(SITE_SUBMIT_POST_SEQUENCE, doc_id=doc_id, seq=msg.seq)
         self._log_append(doc_id, msg)
         self._record_attribution(msg)
         self._enqueue(doc_id, msg)
@@ -492,15 +513,34 @@ class ServingEngineBase:
     def flush(self) -> int:
         """Template: time the subclass's device apply, record batch-size
         and latency metrics, drive the compaction cadence."""
+        # crash here = the window is logged (submit acked after append)
+        # but not yet applied: recovery MUST replay it from the log
+        fault_point(SITE_FLUSH_MID_BATCH, queued=self._queued())
         t0 = time.perf_counter()
+        # degradation injection: an armed plan may stall here (device
+        # hiccup / tunnel RTT spike) — the watchdog below must see it
+        fault_point(SITE_APPLY_STALL, what="flush")
         n = self._flush_impl()
+        elapsed_ms = (time.perf_counter() - t0) * 1000
         if n:
             self.metrics.inc("flushes")
             self.metrics.inc("ops_flushed", n)
-            self.metrics.observe("flush_ms",
-                                 (time.perf_counter() - t0) * 1000)
+            self.metrics.observe("flush_ms", elapsed_ms)
+        self._watch_apply(elapsed_ms, "flush", n)
         self._after_flush(n)
         return n
+
+    def _watch_apply(self, elapsed_ms: float, what: str, n_ops: int) -> None:
+        """Apply watchdog: surface any device apply slower than
+        ``stall_threshold_ms`` as a counted, recorded, warned stall."""
+        if elapsed_ms <= self.stall_threshold_ms:
+            return
+        self.metrics.inc("apply_stalls")
+        event = {"what": what, "ms": elapsed_ms, "ops": n_ops,
+                 "wall": time.time()}
+        self.stall_events.append(event)
+        del self.stall_events[:-self._STALL_KEEP]
+        self.telemetry.send_warning("apply_stall", **event)
 
     def _flush_impl(self) -> int:
         """Apply the queued window on device; returns messages applied."""
@@ -886,6 +926,9 @@ class StringServingEngine(ServingEngineBase):
             ms_arr = np.zeros((self.n_docs,), np.int32)
             for doc_id, row in self._doc_rows.items():
                 ms_arr[row] = self._min_seq.get(doc_id, 0)
+        # degradation injection: an armed plan may stall the device apply
+        # here (tunnel RTT spike); the watchdog below must surface it
+        fault_point(SITE_APPLY_STALL, what="ingest_planes")
         self.store.apply_planes(
             rows, kind_eff, np.asarray(a0, np.int32),
             np.asarray(a1, np.int32), seq_base,
@@ -960,7 +1003,9 @@ class StringServingEngine(ServingEngineBase):
                     int(s), int(c), ts)
         self.metrics.inc("flushes")
         self.metrics.inc("ops_flushed", n_ok)
-        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        elapsed_ms = (time.perf_counter() - t0) * 1000
+        self.metrics.observe("flush_ms", elapsed_ms)
+        self._watch_apply(elapsed_ms, "ingest_planes", n_ok)
         if compact_due:
             self._flushes_since_compact = 0
             self.metrics.inc("compactions")
@@ -1502,6 +1547,7 @@ class MapServingEngine(ServingEngineBase):
         ])
         scatter = not (R == self.n_docs
                        and np.array_equal(rows, np.arange(R)))
+        fault_point(SITE_APPLY_STALL, what="ingest_planes")
         import jax.numpy as jnp
         if getattr(self.store, "mesh", None) is not None:
             from ..ops.map_kernel import map_columnar_unpack_jit
@@ -1539,7 +1585,9 @@ class MapServingEngine(ServingEngineBase):
             self._min_seq[self._row_doc_id[r]] = int(last_min[i])
         self.metrics.inc("flushes")
         self.metrics.inc("ops_flushed", n_ok)
-        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        elapsed_ms = (time.perf_counter() - t0) * 1000
+        self.metrics.observe("flush_ms", elapsed_ms)
+        self._watch_apply(elapsed_ms, "ingest_planes", n_ok)
         return {"seq": seq_rs, "nacked": int(nacked.sum())}
 
     # ----------------------------------------------------------- device side

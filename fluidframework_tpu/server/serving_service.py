@@ -24,6 +24,7 @@ import numpy as np
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..ops.string_store import TensorStringStore
 from ..runtime.remote_message_processor import RemoteMessageProcessor
+from ..utils.telemetry import MetricsCollector, TelemetryLogger
 from .tinylicious import LocalService
 
 
@@ -46,6 +47,12 @@ class ServingLocalService(LocalService):
         self._replica_queue: list = []
         self._doc_min_seq: Dict[str, int] = {}
         self._flushes_since_compact = 0
+        self.metrics = MetricsCollector()
+        self.telemetry = TelemetryLogger(None, "servingService")
+        # channels the replica could NOT admit (store rows exhausted):
+        # the ordering service still serves them — only device reads are
+        # degraded — but the degradation must be VISIBLE, not silent
+        self._dropped_channels: set = set()
         # subscribe the replica AFTER the parent wired its lambdas, so
         # broadcast/storage see each message first (same offset order)
         for p in range(self.deltas_log.n_partitions):
@@ -57,10 +64,27 @@ class ServingLocalService(LocalService):
         key = (doc_id, ds, channel)
         if key not in self._rows:
             if len(self._rows) >= self.n_docs:
-                return None  # replica full: those channels aren't served
+                # replica full: the channel is not served from the device
+                # replica (ordering/broadcast are unaffected). Count every
+                # shed op, warn once per channel — the round-5 failure mode
+                # was exactly this branch returning None with no trace.
+                self.metrics.inc("replica_ops_dropped")
+                if key not in self._dropped_channels:
+                    self._dropped_channels.add(key)
+                    self.metrics.inc("replica_channels_dropped")
+                    self.telemetry.send_warning(
+                        "replicaChannelDropped", doc_id=doc_id,
+                        datastore=ds, channel=channel,
+                        capacity=self.n_docs)
+                return None
             self._rows[key] = len(self._rows)
             self._row_doc[self._rows[key]] = doc_id
         return self._rows[key]
+
+    def dropped_channels(self):
+        """(doc, datastore, channel) keys shed because the replica was
+        full — the operator-facing view of serving degradation."""
+        return sorted(self._dropped_channels)
 
     def _replica_consume(self, partition: int, offset: int,
                          msg: SequencedDocumentMessage) -> None:

@@ -207,3 +207,24 @@ class LocalService:
         """Simulate a Deli partition restart from its checkpoint."""
         with self._lock:
             self.deli = DeliSequencer.restore(checkpoint)
+
+    def save_checkpoint(self, path: str) -> None:
+        """Durable service checkpoint (sequencer state + both logs'
+        offsets), written atomically (tmp + fsync + rename): a kill
+        mid-write can never destroy the previous checkpoint. Recovery =
+        ``restart_sequencer(load)`` + replaying the deltas log from the
+        recorded offsets."""
+        from ..utils.atomicfile import atomic_write_json
+        with self._lock:
+            atomic_write_json(path, {
+                "deli": self.deli.checkpoint(),
+                "raw_offsets": [self.raw_log.size(p) for p in
+                                range(self.raw_log.n_partitions)],
+                "deltas_offsets": [self.deltas_log.size(p) for p in
+                                   range(self.deltas_log.n_partitions)],
+            })
+
+    @staticmethod
+    def load_checkpoint(path: str) -> dict:
+        from ..utils.atomicfile import read_json
+        return read_json(path)

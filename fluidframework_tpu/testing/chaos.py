@@ -1,0 +1,445 @@
+"""Seeded fault-injection ("chaos") harness for the server stack.
+
+The other half of ``utils.faultpoints``: the registry declares WHERE a
+process may die; this module decides WHEN, and asserts what must still be
+true afterwards. Everything is seeded — a failing drill's seed reproduces
+the exact crash schedule — and every drill asserts the same recovery
+contract the production pipeline promises:
+
+- **No acked op is ever lost.** ``submit`` returning is the ack; an op the
+  caller saw acked must be in the recovered state, at any crash site.
+- **Un-acked ops may be dropped but never corrupt.** A crash between
+  sequencing and the durable append loses the op (the client resends); a
+  crash mid-spill leaves a torn tail that recovery truncates.
+- **Recovery is deterministic.** Loading the same summary + log twice
+  yields bit-identical digests; a replica that ingested the same logged
+  ops converges to the same digest (cross-replica parity).
+- **Sequencing resumes monotonically.** Recovered doc seqs continue past
+  the tail; no sequence number is ever reused for a DIFFERENT op.
+
+Drills:
+
+``run_crash_drill(seed)``      engine crash-restart over 4 DDS families ×
+                               4 in-engine sites (deli mid-window, post-
+                               sequence, oplog mid-append, flush mid-batch)
+``run_spill_drill(seed, dir)`` kill mid-JSONL-spill-line → torn tail
+                               truncation on ``PartitionedLog.recover``
+``run_checkpoint_drill(...)``  kill mid-checkpoint-write → the previous
+                               checkpoint survives (tmp+rename atomicity)
+``run_stall_drill(seed)``      injected device-apply stall → the watchdog
+                               counts, records, and warns
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import string as _string
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.protocol import MessageType
+from ..utils.faultpoints import (
+    SITE_APPLY_STALL, SITE_CHECKPOINT_MID_WRITE, SITE_DELI_MID_WINDOW,
+    SITE_FLUSH_MID_BATCH, SITE_OPLOG_MID_APPEND, SITE_OPLOG_MID_SPILL,
+    SITE_SUBMIT_POST_SEQUENCE, CrashInjected, armed,
+)
+
+FAMILIES = ("string", "map", "matrix", "tree")
+
+#: the in-engine sites the generic crash drill can reach through submit()
+CRASH_SITES = (
+    SITE_DELI_MID_WINDOW,
+    SITE_SUBMIT_POST_SEQUENCE,
+    SITE_OPLOG_MID_APPEND,
+    SITE_FLUSH_MID_BATCH,
+)
+
+
+class FaultPlan:
+    """One seeded fault schedule: crash at the Nth hit of a site, stall
+    for S seconds at every hit of a site, or both (different sites).
+
+    ``crash``: {site: n} — the nth ``fault_point(site)`` hit raises
+    ``CrashInjected`` (the in-process stand-in for SIGKILL).
+    ``stall``: {site: seconds} — every hit sleeps (degradation, not death).
+    ``spill_prefix``: at ``SITE_OPLOG_MID_SPILL`` crashes, first write
+    this many bytes of the pending line (then die) — a realistic torn
+    tail mid ``write(2)``; None writes nothing (die before the write).
+    """
+
+    def __init__(self, crash: Optional[Dict[str, int]] = None,
+                 stall: Optional[Dict[str, float]] = None,
+                 spill_prefix: Optional[int] = None):
+        self.crash = dict(crash or {})
+        self.stall = dict(stall or {})
+        self.spill_prefix = spill_prefix
+        self.hits: Dict[str, int] = {}
+        self.fired: List[str] = []
+        self.stalled: List[str] = []
+
+    def hit(self, site: str, **ctx: Any) -> None:
+        n = self.hits[site] = self.hits.get(site, 0) + 1
+        if site in self.stall:
+            self.stalled.append(site)
+            time.sleep(self.stall[site])
+        if self.crash.get(site) == n:
+            if site == SITE_OPLOG_MID_SPILL and self.spill_prefix \
+                    and "line" in ctx and "fh" in ctx:
+                # die mid-write: a PREFIX of the line reaches the disk
+                ctx["fh"].write(ctx["line"][:self.spill_prefix])
+                ctx["fh"].flush()
+            self.fired.append(site)
+            raise CrashInjected(site)
+
+
+# --------------------------------------------------------------- engines
+
+def make_engine(family: str, log=None, n_docs: int = 4):
+    """A small engine of the given family (constant shapes across drills
+    so the jit cache carries between seeds)."""
+    from ..server.serving import (
+        MapServingEngine, MatrixServingEngine, StringServingEngine,
+        TreeServingEngine,
+    )
+    if family == "string":
+        return StringServingEngine(n_docs=n_docs, capacity=512,
+                                   batch_window=8, n_partitions=4, log=log)
+    if family == "map":
+        return MapServingEngine(n_docs=n_docs, n_keys=16, batch_window=8,
+                                n_partitions=4, log=log)
+    if family == "matrix":
+        return MatrixServingEngine(n_docs=n_docs, cell_capacity=4096,
+                                   batch_window=8, n_partitions=4, log=log)
+    if family == "tree":
+        return TreeServingEngine(n_docs=n_docs, capacity=256,
+                                 batch_window=8, n_partitions=4, log=log)
+    raise ValueError(f"unknown family {family!r}")
+
+
+def engine_class(family: str):
+    from ..server import serving
+    return {"string": serving.StringServingEngine,
+            "map": serving.MapServingEngine,
+            "matrix": serving.MatrixServingEngine,
+            "tree": serving.TreeServingEngine}[family]
+
+
+def digest(engine, family: str, docs: List[str]) -> Dict[str, Any]:
+    """Canonical converged read of every doc (flushes first)."""
+    engine.flush()
+    read = getattr(engine, {"string": "read_text", "map": "read_doc",
+                            "matrix": "to_lists", "tree": "to_dict"}[family])
+    return {d: read(d) for d in docs}
+
+
+# ------------------------------------------------------ seeded op streams
+
+class OpGen:
+    """Valid-by-construction op stream for one family: tracks just enough
+    oracle state (text length, matrix dims, live tree nodes) that every
+    generated op passes the engine's structural validation and never
+    nacks on a healthy engine."""
+
+    def __init__(self, rng: random.Random, family: str, docs: List[str]):
+        self.rng = rng
+        self.family = family
+        self._len = {d: 0 for d in docs}            # string
+        self._dims = {d: [0, 0] for d in docs}      # matrix
+        self._nodes: Dict[str, List[str]] = {d: [] for d in docs}  # tree
+        self._n = 0
+
+    def op(self, doc: str) -> dict:
+        self._n += 1
+        return getattr(self, f"_{self.family}")(doc)
+
+    def _string(self, doc: str) -> dict:
+        rng, ln = self.rng, self._len[doc]
+        if ln >= 2 and rng.random() < 0.3:
+            start = rng.randrange(ln - 1)
+            end = rng.randrange(start + 1, ln + 1)
+            self._len[doc] -= end - start
+            return {"mt": "remove", "start": start, "end": end}
+        text = "".join(rng.choices(_string.ascii_lowercase,
+                                   k=rng.randint(1, 6)))
+        pos = rng.randrange(ln + 1)
+        self._len[doc] += len(text)
+        return {"mt": "insert", "kind": 0, "pos": pos, "text": text}
+
+    def _map(self, doc: str) -> dict:
+        rng = self.rng
+        key = f"k{rng.randrange(8)}"
+        r = rng.random()
+        if r < 0.15:
+            return {"op": "delete", "key": key}
+        if r < 0.18:
+            return {"op": "clear"}
+        return {"op": "set", "key": key, "value": rng.randrange(1000)}
+
+    def _matrix(self, doc: str) -> dict:
+        rng, dims = self.rng, self._dims[doc]
+        if dims[0] == 0 or dims[1] == 0 or rng.random() < 0.2:
+            axis = 0 if dims[0] <= dims[1] else 1
+            count = rng.randint(1, 2)
+            pos = rng.randrange(dims[axis] + 1)
+            dims[axis] += count
+            return {"mx": "insRow" if axis == 0 else "insCol",
+                    "pos": pos, "count": count, "opKey": [9, self._n]}
+        return {"mx": "setCell", "row": rng.randrange(dims[0]),
+                "col": rng.randrange(dims[1]),
+                "value": rng.randrange(1000)}
+
+    def _tree(self, doc: str) -> dict:
+        rng, nodes = self.rng, self._nodes[doc]
+        if nodes and rng.random() < 0.4:
+            return {"op": "setValue", "id": rng.choice(nodes),
+                    "value": rng.randrange(1000)}
+        nid = f"{doc}-n{self._n}"
+        nodes.append(nid)
+        return {"op": "insert", "parent": "root", "field": "c",
+                "after": None,
+                "nodes": [{"id": nid, "type": "t",
+                           "value": rng.randrange(100)}]}
+
+
+# ------------------------------------------------------------ log queries
+
+def logged_ops(engine) -> List[Any]:
+    """Every OP message in the engine's durable log, (doc, seq)-sorted —
+    the ground truth recovery replays (columnar records expanded)."""
+    msgs = []
+    for p in range(engine.log.n_partitions):
+        for rec in engine.log.read(p):
+            for m in (rec.expand() if hasattr(rec, "expand") else (rec,)):
+                if m.type == MessageType.OP:
+                    msgs.append(m)
+    msgs.sort(key=lambda m: (m.doc_id, m.seq))
+    return msgs
+
+
+# ---------------------------------------------------------------- drills
+
+def run_crash_drill(seed: int, family: Optional[str] = None,
+                    site: Optional[str] = None) -> dict:
+    """One full crash-restart drill. Seeded end to end; returns a report
+    dict (family, site, whether the fault fired, op counts) and raises
+    AssertionError on any violated recovery invariant."""
+    rng = random.Random(seed)
+    family = family or rng.choice(FAMILIES)
+    site = site or rng.choice(CRASH_SITES)
+    docs = ["d0", "d1", "d2"]
+    clients = {d: i + 1 for i, d in enumerate(docs)}
+
+    victim = make_engine(family)
+    for d in docs:
+        victim.connect(d, clients[d])
+    gen = OpGen(rng, family, docs)
+    cseq = {d: 0 for d in docs}
+    last_seq = {d: 0 for d in docs}
+
+    def push(engine, d: str, contents: dict) -> Any:
+        cseq[d] += 1
+        msg, nack = engine.submit(d, clients[d], cseq[d], last_seq[d],
+                                  contents)
+        assert nack is None, f"healthy submit nacked: {nack}"
+        last_seq[d] = msg.seq
+        return msg
+
+    # phase A: a batch-window of ops, then the recovery anchor
+    for i in range(8):
+        push(victim, docs[i % len(docs)], gen.op(docs[i % len(docs)]))
+    victim.flush()
+    summary = victim.summarize()
+
+    # phase B: keep submitting under an armed crash plan until it fires
+    nth = rng.randint(1, 3)
+    plan = FaultPlan(crash={site: nth})
+    acked: List[Tuple[str, int]] = []          # (doc, client_seq)
+    crashed_at: Optional[Tuple[str, int]] = None
+    with armed(plan):
+        try:
+            for i in range(24):
+                d = docs[i % len(docs)]
+                contents = gen.op(d)
+                cs_before = cseq[d]
+                msg = push(victim, d, contents)
+                acked.append((d, msg.client_seq))
+        except CrashInjected:
+            crashed_at = (d, cs_before + 1)
+            cseq[d] = cs_before + 1  # the crashed op consumed its clientSeq
+    assert plan.fired == [site], \
+        f"plan never fired at {site} (hits={plan.hits})"
+
+    # ---- the victim is dead. Recover from summary + durable log, twice.
+    cls = engine_class(family)
+    recovered = cls.load(summary, victim.log)
+    recovered2 = cls.load(summary, victim.log)
+
+    log_msgs = logged_ops(victim)
+    by_doc: Dict[str, list] = {d: [] for d in docs}
+    for m in log_msgs:
+        by_doc[m.doc_id].append(m)
+
+    # invariant 1: recovery is deterministic (double-load bit identity)
+    dg = digest(recovered, family, docs)
+    assert dg == digest(recovered2, family, docs), \
+        "double load of the same summary+log diverged"
+
+    # invariant 2: no acked op lost — every ack has a durable log record
+    logged_keys = {(m.doc_id, m.client_seq) for m in log_msgs}
+    for key in acked:
+        assert key in logged_keys, \
+            f"acked op {key} missing from the durable log ({site})"
+
+    # invariant 3: monotone per-doc seqs in the log, and the recovered
+    # sequencer resumes at (not before) the last logged seq
+    for d in docs:
+        seqs = [m.seq for m in by_doc[d]]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs), \
+            f"non-monotone log seqs for {d}: {seqs}"
+        if seqs:
+            assert recovered.deli.doc_seq(d) >= seqs[-1], \
+                f"recovered seq below log tail for {d}"
+
+    # invariant 4: cross-replica convergence — a twin fed exactly the
+    # logged ops (the resend-after-crash world) reads identically
+    twin = make_engine(family)
+    for d in docs:
+        twin.connect(d, clients[d])
+    for d in docs:
+        for m in by_doc[d]:
+            _, nack = twin.submit(d, m.client_id, m.client_seq,
+                                  m.ref_seq, m.contents)
+            assert nack is None, f"twin replay nacked: {nack}"
+    assert dg == digest(twin, family, docs), \
+        f"recovered digest != twin digest ({family}/{site}, seed {seed})"
+
+    # invariant 5: life goes on — new ops land and sequence PAST the tail
+    for d in docs:
+        next_cs = max((m.client_seq for m in by_doc[d]), default=0) + 1
+        tail_seq = recovered.deli.doc_seq(d)
+        msg, nack = recovered.submit(
+            d, clients[d], next_cs,
+            by_doc[d][-1].seq if by_doc[d] else 0, gen.op(d))
+        assert nack is None, f"post-recovery submit nacked: {nack}"
+        assert msg.seq == tail_seq + 1, "post-recovery seq not monotone"
+
+    return {"family": family, "site": site, "seed": seed,
+            "acked": len(acked), "logged": len(log_msgs),
+            "crashed_at": crashed_at}
+
+
+def run_spill_drill(seed: int, spill_dir: str) -> dict:
+    """Kill the engine mid-JSONL-spill-line; recover the log FROM DISK.
+    The torn tail must be dropped and truncated, every fully-written
+    record must survive byte-identically, and appends must continue
+    cleanly on the recovered log."""
+    from ..server.oplog import PartitionedLog
+    rng = random.Random(seed)
+    docs = ["d0", "d1"]
+    log = PartitionedLog(2, spill_dir, "chaos")
+    victim = make_engine("string", log=log)
+    for i, d in enumerate(docs):
+        victim.connect(d, i + 1)
+    gen = OpGen(rng, "string", docs)
+    cseq = {d: 0 for d in docs}
+    acked = []
+    n_pre = rng.randint(3, 8)
+    plan = FaultPlan(crash={SITE_OPLOG_MID_SPILL: n_pre + 1},
+                     spill_prefix=rng.randint(1, 20))
+    with armed(plan):
+        try:
+            for i in range(n_pre + 4):
+                d = docs[i % 2]
+                cseq[d] += 1
+                msg, nack = victim.submit(d, (i % 2) + 1, cseq[d], 0,
+                                          gen.op(d))
+                assert nack is None
+                acked.append((d, msg.client_seq, msg.seq))
+        except CrashInjected:
+            pass
+    assert plan.fired, "spill fault never fired"
+    log.close()
+
+    recovered = PartitionedLog.recover(2, spill_dir, "chaos")
+    rec_msgs = []
+    for p in range(2):
+        rec_msgs.extend(m for m in recovered.read(p)
+                        if m.type == MessageType.OP)
+    rec_keys = {(m.doc_id, m.client_seq) for m in rec_msgs}
+    # every acked op survived; the torn (never-acked) record did not
+    for d, cs, _ in acked:
+        assert (d, cs) in rec_keys, f"acked op ({d},{cs}) lost to torn tail"
+    # the files are clean: append + a second recovery round-trips
+    recovered.append(0, rec_msgs[0])
+    recovered.close()
+    again = PartitionedLog.recover(2, spill_dir, "chaos")
+    assert again.size(0) == recovered.size(0), "post-truncate append torn"
+    again.close()
+    return {"seed": seed, "acked": len(acked),
+            "recovered": len(rec_msgs)}
+
+
+def run_checkpoint_drill(seed: int, path: str) -> dict:
+    """Kill the sequencer mid-checkpoint-write. The PREVIOUS checkpoint
+    file must survive byte-identically (tmp + fsync + rename), and a
+    subsequent save must succeed."""
+    from ..server.deli import DeliSequencer
+    rng = random.Random(seed)
+    deli = DeliSequencer()
+    for i in range(rng.randint(1, 3)):
+        deli.client_join("doc", i + 1)
+        deli.sequence("doc", i + 1, 1, 0, MessageType.OP, {"n": i})
+    deli.save_checkpoint(path)
+    with open(path, "rb") as f:
+        before = f.read()
+
+    deli.sequence("doc", 1, 2, 0, MessageType.OP, {"n": 99})
+    plan = FaultPlan(crash={SITE_CHECKPOINT_MID_WRITE: 1})
+    with armed(plan):
+        try:
+            deli.save_checkpoint(path)
+            raise AssertionError("checkpoint fault never fired")
+        except CrashInjected:
+            pass
+    with open(path, "rb") as f:
+        assert f.read() == before, "torn checkpoint destroyed predecessor"
+    restored = DeliSequencer.load_checkpoint(path)
+    assert restored.doc_seq("doc") == DeliSequencer.restore(
+        __import__("json").loads(before)).doc_seq("doc")
+    # no tmp debris blocks the next save
+    deli.save_checkpoint(path)
+    assert DeliSequencer.load_checkpoint(path).doc_seq("doc") \
+        == deli.doc_seq("doc")
+    leftovers = [f for f in os.listdir(os.path.dirname(path) or ".")
+                 if f.endswith(".tmp")]
+    assert not leftovers, f"tmp debris after crash: {leftovers}"
+    return {"seed": seed}
+
+
+def run_stall_drill(seed: int, family: str = "string",
+                    stall_s: float = 0.05) -> dict:
+    """Inject a device-apply stall; the engine watchdog must count it,
+    record a bounded event, and warn through telemetry."""
+    from ..utils.telemetry import BufferSink, TelemetryLogger
+    rng = random.Random(seed)
+    engine = make_engine(family)
+    engine.stall_threshold_ms = stall_s * 1000 / 4
+    sink = BufferSink()
+    engine.telemetry = TelemetryLogger(sink, "serving")
+    docs = ["d0"]
+    engine.connect("d0", 1)
+    gen = OpGen(rng, family, docs)
+    plan = FaultPlan(stall={SITE_APPLY_STALL: stall_s})
+    with armed(plan):
+        for i in range(8):  # one full batch window → one flush
+            engine.submit("d0", 1, i + 1, 0, gen.op("d0"))
+        engine.flush()
+    stalls = engine.metrics.counters.get("apply_stalls", 0)
+    assert stalls >= 1, engine.metrics.snapshot()
+    assert engine.stall_events and \
+        engine.stall_events[-1]["ms"] >= engine.stall_threshold_ms
+    warned = sink.named("apply_stall")
+    assert warned, f"no stall warning in telemetry: {sink.events}"
+    return {"seed": seed, "stalls": stalls,
+            "events": len(engine.stall_events)}

@@ -1,0 +1,107 @@
+"""Named fault-injection points for the server stack.
+
+The chaos harness (``testing.chaos``) needs to kill the pipeline at
+*arbitrary, named* places — mid-sequencing, between a durable append and
+its spill write, between a summary upload and its ack — and the
+production code needs to pay nothing for that capability when no drill
+is running. This module is the contract between the two: server code
+drops a ``fault_point("site.name")`` call at each interesting boundary
+(one global ``is None`` check when disarmed), and a drill installs a
+:class:`testing.chaos.FaultPlan` that decides — per site, per hit —
+whether to crash (:class:`CrashInjected`), stall, or pass through.
+
+Sites are registered at import time of the module that hosts them, so
+``registered_sites()`` documents the full injection surface and drills
+can assert they cover it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+#: every site name ever declared via :func:`declare_site` — the
+#: discoverable injection surface (drills sweep it; reviews audit it).
+_SITES: Set[str] = set()
+
+_lock = threading.Lock()
+_plan = None  # the installed plan, or None (disarmed)
+
+
+class CrashInjected(RuntimeError):
+    """Raised by an armed fault plan to simulate a process kill at a
+    fault point. Carries the site name; drills catch it and run the
+    recovery path exactly as a restarted process would."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected crash at {site}")
+        self.site = site
+
+
+def declare_site(name: str) -> str:
+    """Register a site name (idempotent); returns it so hosts can write
+    ``SITE_X = declare_site("x")`` and pass the constant around."""
+    with _lock:
+        _SITES.add(name)
+    return name
+
+
+def registered_sites() -> Set[str]:
+    with _lock:
+        return set(_SITES)
+
+
+def install(plan) -> None:
+    """Arm ``plan`` globally. Only one plan at a time — nested drills
+    would make hit counts meaningless."""
+    global _plan
+    with _lock:
+        if _plan is not None:
+            raise RuntimeError("a fault plan is already installed")
+        _plan = plan
+
+
+def uninstall() -> None:
+    global _plan
+    with _lock:
+        _plan = None
+
+
+def active_plan():
+    return _plan
+
+
+def fault_point(site: str, **ctx) -> None:
+    """The hook server code calls. Disarmed: one global read, no other
+    work. Armed: the plan decides (crash / stall / nothing)."""
+    plan = _plan
+    if plan is not None:
+        plan.hit(site, **ctx)
+
+
+class armed:
+    """``with armed(plan): ...`` — install for the block, always
+    uninstall (even when the block exits via CrashInjected)."""
+
+    def __init__(self, plan):
+        self.plan = plan
+
+    def __enter__(self):
+        install(self.plan)
+        return self.plan
+
+    def __exit__(self, *_exc):
+        uninstall()
+        return False
+
+
+# Core sites declared centrally (hosts may declare more):
+SITE_DELI_MID_WINDOW = declare_site("deli.sequence.mid_window")
+SITE_OPLOG_MID_APPEND = declare_site("oplog.append.mid")
+SITE_OPLOG_MID_SPILL = declare_site("oplog.spill.mid_line")
+SITE_SUBMIT_POST_SEQUENCE = declare_site("serving.submit.post_sequence")
+SITE_FLUSH_MID_BATCH = declare_site("serving.flush.mid_batch")
+SITE_INGEST_MID_BATCH = declare_site("serving.ingest.mid_batch")
+SITE_SUMMARIZER_POST_UPLOAD = declare_site("summarizer.post_upload")
+SITE_CHECKPOINT_MID_WRITE = declare_site("checkpoint.mid_write")
+SITE_APPLY_STALL = declare_site("serving.apply.stall")

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional
 GENERIC = "generic"
 PERFORMANCE = "performance"
 ERROR = "error"
+WARNING = "warning"   # degraded-but-serving conditions (shed load, stalls)
 
 Sink = Callable[[dict], None]
 
@@ -63,6 +64,12 @@ class TelemetryLogger:
             props.setdefault("error", repr(error))
             props.setdefault("errorType", type(error).__name__)
         self.send(ERROR, event_name, **props)
+
+    def send_warning(self, event_name: str, **props) -> None:
+        """Degradation events: the system is still serving but shedding
+        load or running slow — these must be VISIBLE (replica overflow,
+        slow-consumer evictions, apply stalls), never silent."""
+        self.send(WARNING, event_name, **props)
 
     def performance_event(self, event_name: str,
                           **props) -> "PerformanceEvent":

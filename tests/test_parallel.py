@@ -61,7 +61,8 @@ def test_replicated_step_multiple_rounds():
                               np.asarray(string_state_digest(ref)))
 
 
-def test_graft_entry_and_dryrun():
+@pytest.mark.slow  # two full subprocess engine drills, ~9 min — the
+def test_graft_entry_and_dryrun():  # driver runs dryrun_multichip itself
     import importlib.util
     spec = importlib.util.spec_from_file_location(
         "graft_entry", "/root/repo/__graft_entry__.py")
