@@ -913,6 +913,26 @@ def run():
     worst_ms = float(max(samples) * 1000 / ops_per_batch)
 
     rtt_monitor.stop()
+
+    # observability ride-along: the unified registry's process-wide view
+    # (device dispatches, jit compiles vs cache hits, oplog appends, ...)
+    # plus ONE sampled span timeline from the run's newest trace, so a
+    # bench record alone shows where a batch's wall time went
+    from fluidframework_tpu.utils import tracing as _tracing
+    from fluidframework_tpu.utils.telemetry import REGISTRY as _registry
+    _tids = _tracing.TRACER.trace_ids()
+    _trace_sample = None
+    if _tids:
+        _tid = _tids[-1]
+        _trace_sample = {
+            "trace_id": _tid,
+            "spans": [{"name": e["name"], "dur_ms": round(e["dur"] / 1e3, 3),
+                       "parent_id": e["parent_id"], "span_id": e["span_id"],
+                       "args": {k: v for k, v in e.get("args", {}).items()
+                                if isinstance(v, (int, float, str, bool))}}
+                      for e in _tracing.TRACER.events(_tid)[:32]],
+        }
+
     print(json.dumps({
         "metric": "sharedstring_ops_per_sec_merged",
         "value": round(ops_per_sec, 1),
@@ -978,6 +998,11 @@ def run():
         "ack_device_round_trips": 0,
         "conflict_ops_per_sec": round(conflict_ops_per_sec, 1),
         "conflict_parity": conflict_parity,
+        # unified metrics registry snapshot (counters + gauges + histogram
+        # percentiles, own + attached components) and one sampled span
+        # timeline — see utils.telemetry / utils.tracing
+        "metrics": _registry.full_snapshot(),
+        "trace_sample": _trace_sample,
         "backend": jax.default_backend(),
     }))
 

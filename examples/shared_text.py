@@ -9,7 +9,10 @@ metadata, and presence cursors over signals.
 
 Run: ``PYTHONPATH=. python examples/shared_text.py`` — simulates a
 three-author editing session over the in-process service and prints the
-converged document.
+converged document. With ``--trace out.json`` the session's span trees
+(outbox → wire → deli → serving apply → ack, one per op batch) are
+exported as Chrome trace-event JSON and the first batch's tree is
+printed via ``tools.trace_viewer``.
 """
 
 from __future__ import annotations
@@ -127,6 +130,21 @@ def main() -> int:
     assert all(a.formatted_runs() == author2.formatted_runs()
                for a in (author1, author3))
     print("converged: yes")
+
+    if "--trace" in sys.argv:
+        from fluidframework_tpu.tools import trace_viewer
+        from fluidframework_tpu.utils import tracing
+        path = sys.argv[sys.argv.index("--trace") + 1]
+        tracing.TRACER.export_chrome(path)
+        tids = tracing.TRACER.trace_ids()
+        print(f"trace    : {len(tids)} trace(s) -> {path}")
+        # show the first CLIENT batch (root = outbox.flush), not the
+        # join-only service traces
+        batch = [e["trace_id"] for e in tracing.TRACER.events()
+                 if e["name"] == "outbox.flush"]
+        if batch or tids:
+            tid = batch[0] if batch else tids[0]
+            print(trace_viewer.render(tracing.TRACER.events(tid)))
     return 0
 
 

@@ -81,6 +81,10 @@ class SequencedDocumentMessage:
     # service-stamped wall time (reference: ISequencedDocumentMessage
     # .timestamp, stamped by Deli) — the "when" of attribution
     timestamp: Optional[float] = None
+    # trace context (utils.tracing wire dict {"tid", "sid"}): links this
+    # sequenced op back to the client batch's span tree; None when the
+    # submitting path was untraced
+    trace: Optional[dict] = None
 
     def is_from(self, client_id: int) -> bool:
         return self.client_id == client_id

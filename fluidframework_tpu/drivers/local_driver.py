@@ -13,6 +13,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..server.tinylicious import LocalService
+from ..utils import tracing
 from . import definitions as defs
 
 
@@ -32,7 +33,10 @@ class LocalDeltaStreamConnection(defs.DeltaStreamConnection):
 
     def submit(self, contents: Any, type: MessageType = MessageType.OP,
                ref_seq: int = 0, address: Optional[str] = None) -> int:
-        client_seq = self._conn.submit(contents, type, ref_seq, address)
+        # wire span: zero serialization here, but the span keeps the tree
+        # shape identical to the socket driver's (outbox → wire → deli)
+        with tracing.span("wire.submit"):
+            client_seq = self._conn.submit(contents, type, ref_seq, address)
         # the local pipeline is synchronous: a nack produced by this submit
         # is already recorded on the connection — deliver it now (a socket
         # driver would push it asynchronously instead)

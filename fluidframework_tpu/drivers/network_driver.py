@@ -23,6 +23,7 @@ from typing import Any, Callable, List, Optional, Tuple
 from ..core.protocol import MessageType, SequencedDocumentMessage, \
     SignalMessage
 from ..server import wire
+from ..utils import tracing
 from . import definitions as defs
 
 
@@ -112,10 +113,14 @@ class NetworkDeltaStreamConnection(defs.DeltaStreamConnection):
             if type != MessageType.NOOP:
                 self._client_seq += 1
             cseq = self._client_seq if type != MessageType.NOOP else 0
-            wire.send_frame(self._sock, {
-                "t": "op", "contents": contents, "type": int(type),
-                "client_seq": cseq,
-                "ref_seq": ref_seq, "address": address})
+            with tracing.span("wire.submit") as sp:
+                # the span's own context crosses the socket: the server
+                # side re-attaches it so deli parents under THIS hop
+                wire.send_frame(self._sock, {
+                    "t": "op", "contents": contents, "type": int(type),
+                    "client_seq": cseq,
+                    "ref_seq": ref_seq, "address": address,
+                    "trace": sp.ctx.to_wire() if sp.ctx else None})
         return cseq if type != MessageType.NOOP else self._client_seq
 
     def on_op(self, fn) -> None:

@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..drivers.definitions import DocumentService, DocumentServiceFactory
+from ..utils import tracing
 from .delta_manager import DeltaManager
 from .protocol import ProtocolHandler
 
@@ -142,7 +143,13 @@ class Container:
             # client (found by the network-driver e2e drill; the local
             # driver's synchronous acks never expose the race)
             local = msg.client_id in self._my_client_ids
-            self.runtime.process(msg, local)
+            if local:
+                # the batch's span tree closes here: the submitting
+                # client processing its own sequenced echo IS the ack
+                with tracing.span("ack", parent=msg.trace, seq=msg.seq):
+                    self.runtime.process(msg, local)
+            else:
+                self.runtime.process(msg, local)
         self._emit("op", msg)
 
     # --------------------------------------------------------------- outbound

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..models.shared_object import ChannelRegistry, default_registry
+from ..utils.telemetry import REGISTRY
 from .datastore import FluidDataStoreRuntime
 from .gc import GarbageCollector
 from .id_compressor import IdCompressor, IdCreationRange
@@ -195,6 +196,7 @@ class ContainerRuntime:
     def process(self, msg: SequencedDocumentMessage, local: bool) -> None:
         """The processOp loop (§3.2): expand one wire message and route."""
         self.last_seq = msg.seq
+        REGISTRY.inc("runtime_ops_processed")
         if msg.type != MessageType.OP:
             self._emit("op", msg, local)
             return

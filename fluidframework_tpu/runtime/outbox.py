@@ -29,6 +29,8 @@ import json
 import zlib
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils import tracing
+
 # envelope op types (carried inside MessageType.OP contents)
 GROUPED_BATCH = "groupedBatch"
 COMPRESSED = "compressed"
@@ -97,16 +99,21 @@ class Outbox:
         if self.main.empty:
             return 0
         batch = self.main.pop_batch()
-        if self.grouped_batching and len(batch) > 1:
-            envelope = {"type": GROUPED_BATCH,
-                        "contents": [{"contents": op["contents"],
-                                      "metadata": op["metadata"]}
-                                     for op in batch]}
-            return self._send_maybe_compressed(envelope, None)
-        sent = 0
-        for op in batch:
-            sent += self._send_maybe_compressed(op["contents"],
-                                                op["metadata"])
+        # trace root: one batch = one trace; every downstream layer
+        # (wire, deli, apply, ack) parents its span under this one
+        with tracing.span("outbox.flush", ops=len(batch)) as sp:
+            if self.grouped_batching and len(batch) > 1:
+                envelope = {"type": GROUPED_BATCH,
+                            "contents": [{"contents": op["contents"],
+                                          "metadata": op["metadata"]}
+                                         for op in batch]}
+                sent = self._send_maybe_compressed(envelope, None)
+            else:
+                sent = 0
+                for op in batch:
+                    sent += self._send_maybe_compressed(op["contents"],
+                                                        op["metadata"])
+            sp.annotate(wire_ops=sent)
         return sent
 
     def _send_maybe_compressed(self, contents: dict,

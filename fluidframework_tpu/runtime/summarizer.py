@@ -35,7 +35,9 @@ import time
 from typing import Callable, Optional
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
+from ..utils import tracing
 from ..utils.faultpoints import SITE_SUMMARIZER_POST_UPLOAD, fault_point
+from ..utils.telemetry import REGISTRY
 
 
 @dataclasses.dataclass
@@ -164,26 +166,37 @@ class SummaryManager:
         summarizeOnDemand.)"""
         container = self.container
         seq = container.protocol.seq
-        summary = {
-            "protocol": container.protocol.snapshot(),
-            # incremental is a no-op until the first ack establishes the
-            # handle-reuse baseline (summarize falls back to full)
-            "runtime": container.runtime.summarize(
-                incremental=self.config.incremental),
-        }
-        self._inflight_capture = container.runtime.take_summary_capture()
-        handle = container.service.summary_storage.upload_summary(
-            summary, seq)
-        # crash here = summary uploaded but the SUMMARIZE proposal never
-        # sequenced: the upload is an orphan blob, no ack ever references
-        # it, and a restarted summarizer must re-propose from the last
-        # ACKED summary (never resume this one)
-        fault_point(SITE_SUMMARIZER_POST_UPLOAD, seq=seq, handle=handle)
-        # mark in-flight BEFORE submit: the synchronous local pipeline
-        # processes the echo (which records pending_proposal) and the ack
-        # reentrantly inside this call
-        self._in_flight = True
-        self.pending_proposal = None
-        container.submit({"handle": handle, "summarySeq": seq},
-                         MessageType.SUMMARIZE)
+        with tracing.span("summarize", seq=seq) as sp:
+            with tracing.span("summarize.build"):
+                summary = {
+                    "protocol": container.protocol.snapshot(),
+                    # incremental is a no-op until the first ack
+                    # establishes the handle-reuse baseline (summarize
+                    # falls back to full)
+                    "runtime": container.runtime.summarize(
+                        incremental=self.config.incremental),
+                }
+            self._inflight_capture = \
+                container.runtime.take_summary_capture()
+            t0 = time.perf_counter()
+            handle = container.service.summary_storage.upload_summary(
+                summary, seq)
+            REGISTRY.inc("summary_uploads")
+            REGISTRY.observe("summary_upload_ms",
+                             (time.perf_counter() - t0) * 1000)
+            sp.annotate(handle=handle)
+            # crash here = summary uploaded but the SUMMARIZE proposal
+            # never sequenced: the upload is an orphan blob, no ack ever
+            # references it, and a restarted summarizer must re-propose
+            # from the last ACKED summary (never resume this one)
+            fault_point(SITE_SUMMARIZER_POST_UPLOAD, seq=seq,
+                        handle=handle)
+            # mark in-flight BEFORE submit: the synchronous local
+            # pipeline processes the echo (which records
+            # pending_proposal) and the ack reentrantly inside this call
+            self._in_flight = True
+            self.pending_proposal = None
+            REGISTRY.inc("summary_proposals")
+            container.submit({"handle": handle, "summarySeq": seq},
+                             MessageType.SUMMARIZE)
         return seq

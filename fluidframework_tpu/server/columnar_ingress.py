@@ -44,6 +44,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import tracing
+from ..utils.telemetry import REGISTRY
+
 _HDR = struct.Struct("<BI")
 _OP_DTYPE = np.dtype([("row", "<u2"), ("kind", "u1"), ("a0", "<u2"),
                       ("a1", "<u2"), ("tidx", "u1"), ("cseq", "<u4"),
@@ -307,9 +310,11 @@ class ColumnarAlfred:
                 tidx[j, 0] = h
         self._pending_rows.extend(again)
         self._pending_ops -= n
-        res = self.engine.ingest_planes(
-            rows, client, cseq, ref, kind, a0, a1,
-            texts=texts or [""], tidx=tidx)
+        with tracing.TRACER.maybe_root_span(
+                "columnar.flush_window", every=256, ops=int(n)):
+            res = self.engine.ingest_planes(
+                rows, client, cseq, ref, kind, a0, a1,
+                texts=texts or [""], tidx=tidx)
         seqs = np.asarray(res["seq"]).reshape(-1)
         # fan the acks back, one frame per participating session
         per_sess: Dict[_ColSession, list] = {}
@@ -320,6 +325,8 @@ class ColumnarAlfred:
             sess._push_json({"t": "acks", "acks": acks})
         self.windows_flushed += 1
         self.ops_ingested += n
+        REGISTRY.inc("columnar_windows_flushed")
+        REGISTRY.inc("columnar_ops_ingested", n)
         return n
 
     async def _flusher(self) -> None:
@@ -405,6 +412,7 @@ def connect_with_backoff(host: str, port: int, attempts: int = 5,
         except OSError as e:
             last_err = e
             if i < attempts - 1:
+                REGISTRY.inc("columnar_connect_backoffs")
                 time.sleep(base_delay * (2 ** i))
     raise ConnectionError(
         f"columnar ingress {host}:{port} unreachable after "

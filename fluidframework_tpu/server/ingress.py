@@ -24,6 +24,8 @@ from typing import Optional
 from . import wire
 from .tinylicious import DeltaConnection, LocalService
 from ..core.protocol import MessageType
+from ..utils import tracing
+from ..utils.telemetry import REGISTRY
 
 
 class _Session:
@@ -90,6 +92,7 @@ class _Session:
             # path resyncs via deltas
             self._evicted = True
             self.server.evictions += 1
+            REGISTRY.inc("ingress_evictions")
             self.writer.close()
 
     async def _error(self, message: str) -> None:
@@ -118,10 +121,17 @@ class _Session:
             if self.conn is None:
                 await self._error("not connected")
                 return False
-            self.conn.submit_raw(req.get("client_seq", 0),
-                                 req.get("contents"),
-                                 MessageType(req.get("type", 0)),
-                                 req.get("ref_seq", 0), req.get("address"))
+            REGISTRY.inc("ingress_ops")
+            # the frame carried the client's wire-span context across the
+            # socket: re-attach so the synchronous pipeline (deli → apply
+            # → broadcast) parents under the client's trace
+            with tracing.attach(req.get("trace")), \
+                    tracing.span("ingress.op"):
+                self.conn.submit_raw(req.get("client_seq", 0),
+                                     req.get("contents"),
+                                     MessageType(req.get("type", 0)),
+                                     req.get("ref_seq", 0),
+                                     req.get("address"))
             self._drain_nacks()
         elif t == "signal":
             if self.conn is None:
@@ -183,10 +193,12 @@ class AlfredServer:
             except OSError:
                 if i == bind_attempts - 1:
                     raise
+                REGISTRY.inc("ingress_bind_retries")
                 await asyncio.sleep(base_delay * (2 ** i))
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def _accept(self, reader, writer) -> None:
+        REGISTRY.inc("ingress_accepts")
         await _Session(self, reader, writer,
                        max_outbound=self.max_outbound).run()
 

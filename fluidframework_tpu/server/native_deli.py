@@ -13,6 +13,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from ..native.build import ensure_built
+from ..utils.telemetry import REGISTRY
 from .deli import NackReason
 
 _NACK_BY_CODE = {
@@ -109,7 +110,9 @@ class NativeDeli:
             self._h, doc_id.encode(), client, client_seq, ref_seq,
             int(is_noop), ctypes.byref(out_min))
         if seq < 0:
+            REGISTRY.inc("native_deli_nacks")
             return None, None, _NACK_BY_CODE[int(seq)]
+        REGISTRY.inc("native_deli_ops")
         return int(seq), int(out_min.value), None
 
     def sequence_batch(self, doc_id: str, clients, client_seqs, ref_seqs,
@@ -131,6 +134,10 @@ class NativeDeli:
             p(clients, ctypes.c_int32), p(client_seqs, ctypes.c_int32),
             p(ref_seqs, ctypes.c_int32), p(is_noop, ctypes.c_int32),
             p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
+        nacks = int(np.count_nonzero(out_seq < 0))
+        REGISTRY.inc("native_deli_batch_ops", n - nacks)
+        if nacks:
+            REGISTRY.inc("native_deli_nacks", nacks)
         return out_seq, out_min
 
     def doc_handle(self, doc_id: str) -> int:
@@ -157,6 +164,10 @@ class NativeDeli:
             p(clients, ctypes.c_int32), p(client_seqs, ctypes.c_int32),
             p(ref_seqs, ctypes.c_int32), p(is_noop, ctypes.c_int32),
             p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
+        nacks = int(np.count_nonzero(out_seq < 0))
+        REGISTRY.inc("native_deli_batch_ops", n - nacks)
+        if nacks:
+            REGISTRY.inc("native_deli_nacks", nacks)
         return out_seq, out_min
 
     def replay(self, doc_id: str, client: int, client_seq: int,

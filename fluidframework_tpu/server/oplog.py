@@ -30,6 +30,7 @@ import numpy as np
 from ..utils.faultpoints import (
     SITE_OPLOG_MID_APPEND, SITE_OPLOG_MID_SPILL, fault_point,
 )
+from ..utils.telemetry import REGISTRY
 
 
 def _spill_json(o):
@@ -154,6 +155,7 @@ class PartitionedLog:
                 continue
             recs, good_end, torn = _read_spill_tolerant(path)
             if torn:
+                REGISTRY.inc("oplog_torn_tails_recovered")
                 with open(path, "r+b") as f:
                     f.truncate(good_end)
             records.append(recs)
@@ -169,6 +171,7 @@ class PartitionedLog:
             part = self._parts[partition]
             offset = len(part)
             part.append(record)
+            REGISTRY.inc("oplog_appends")
             # crash here = record in memory, nothing durable, NOT acked
             fault_point(SITE_OPLOG_MID_APPEND, partition=partition,
                         offset=offset)
@@ -182,6 +185,8 @@ class PartitionedLog:
                             fh=self._spill[partition])
                 self._spill[partition].write(line)
                 self._spill[partition].flush()
+                REGISTRY.inc("oplog_spill_lines")
+                REGISTRY.inc("oplog_spill_bytes", len(line))
             for fn in list(self._subs[partition]):
                 fn(partition, offset, record)
         return offset

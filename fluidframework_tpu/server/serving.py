@@ -35,7 +35,8 @@ from ..utils.faultpoints import (
     SITE_APPLY_STALL, SITE_FLUSH_MID_BATCH, SITE_INGEST_MID_BATCH,
     SITE_SUBMIT_POST_SEQUENCE, fault_point,
 )
-from ..utils.telemetry import MetricsCollector, TelemetryLogger
+from ..utils import flight_recorder, tracing
+from ..utils.telemetry import MetricsCollector, REGISTRY, TelemetryLogger
 from ..ops.map_kernel import TensorMapStore
 from ..ops.schema import OpKind
 from ..ops.string_store import TensorStringStore
@@ -242,8 +243,10 @@ class ServingEngineBase:
         # Deli seqs are per-doc, so a shared table would collide across docs
         self._attributors: Optional[Dict[str, Any]] = None
         # per-lambda observability (SURVEY.md §5.5: op rate, nacks by
-        # reason, flush batch sizes, flush latency percentiles)
+        # reason, flush batch sizes, flush latency percentiles);
+        # attached to the process registry for unified exposition
         self.metrics = MetricsCollector()
+        REGISTRY.attach(type(self).__name__, self.metrics)
         # structured events (attach a sink via telemetry._sink or replace
         # the logger); the apply watchdog warns through it
         self.telemetry = TelemetryLogger(None, "serving")
@@ -457,22 +460,32 @@ class ServingEngineBase:
         except KeyError:
             return self._nacked(Nack(doc_id, client_id, client_seq,
                                      NackReason.CAPACITY))
-        msg, nack = self.deli.sequence(
-            doc_id, client_id, client_seq, ref_seq, MessageType.OP, contents)
-        if nack is not None:
-            self._unadmit(doc_id, contents)
-            return self._nacked(nack)
-        self.metrics.inc("ops_ingested")
-        # crash here = sequenced but never logged: the op was NOT acked
-        # (submit didn't return), so recovery may drop it — but sequencer
-        # counters restored from the log must stay monotone regardless
-        fault_point(SITE_SUBMIT_POST_SEQUENCE, doc_id=doc_id, seq=msg.seq)
-        self._log_append(doc_id, msg)
-        self._record_attribution(msg)
-        self._enqueue(doc_id, msg)
-        self._min_seq[doc_id] = msg.min_seq
-        if self._queued() >= self.batch_window:
-            self.flush()
+        with tracing.span("serving.submit", doc=doc_id) as sp:
+            msg, nack = self.deli.sequence(
+                doc_id, client_id, client_seq, ref_seq, MessageType.OP,
+                contents)
+            if nack is not None:
+                self._unadmit(doc_id, contents)
+                return self._nacked(nack)
+            self.metrics.inc("ops_ingested")
+            sp.annotate(seq=msg.seq)
+            # the engine's ack (returning msg) closes this span; carry
+            # the context on the message so flush — often a later batch
+            # on another call — still links to the submitting trace
+            if sp.ctx is not None:
+                msg.trace = sp.ctx.to_wire()
+            # crash here = sequenced but never logged: the op was NOT
+            # acked (submit didn't return), so recovery may drop it —
+            # but sequencer counters restored from the log must stay
+            # monotone regardless
+            fault_point(SITE_SUBMIT_POST_SEQUENCE, doc_id=doc_id,
+                        seq=msg.seq)
+            self._log_append(doc_id, msg)
+            self._record_attribution(msg)
+            self._enqueue(doc_id, msg)
+            self._min_seq[doc_id] = msg.min_seq
+            if self._queued() >= self.batch_window:
+                self.flush()
         return msg, None
 
     def _nacked(self, nack: Nack) -> Tuple[None, Nack]:
@@ -516,12 +529,21 @@ class ServingEngineBase:
         # crash here = the window is logged (submit acked after append)
         # but not yet applied: recovery MUST replay it from the log
         fault_point(SITE_FLUSH_MID_BATCH, queued=self._queued())
-        t0 = time.perf_counter()
-        # degradation injection: an armed plan may stall here (device
-        # hiccup / tunnel RTT spike) — the watchdog below must see it
-        fault_point(SITE_APPLY_STALL, what="flush")
-        n = self._flush_impl()
-        elapsed_ms = (time.perf_counter() - t0) * 1000
+        # flush parents under the newest queued op's submit span when
+        # one exists (batch-triggered flush), else under the caller's
+        # context (explicit flush inside a traced read)
+        parent = None
+        if self._queue:
+            parent = getattr(self._queue[-1][1], "trace", None)
+        with tracing.span("serving.flush", parent=parent,
+                          queued=self._queued()) as sp:
+            t0 = time.perf_counter()
+            # degradation injection: an armed plan may stall here (device
+            # hiccup / tunnel RTT spike) — the watchdog below must see it
+            fault_point(SITE_APPLY_STALL, what="flush")
+            n = self._flush_impl()
+            elapsed_ms = (time.perf_counter() - t0) * 1000
+            sp.annotate(ops=n, ms=elapsed_ms)
         if n:
             self.metrics.inc("flushes")
             self.metrics.inc("ops_flushed", n)
@@ -541,6 +563,11 @@ class ServingEngineBase:
         self.stall_events.append(event)
         del self.stall_events[:-self._STALL_KEEP]
         self.telemetry.send_warning("apply_stall", **event)
+        # stall context goes straight into the crash flight recorder:
+        # if the NEXT thing that happens is a faultpoint crash or a
+        # drill assertion, the dump shows the stall that preceded it
+        flight_recorder.note("apply_stall",
+                             engine=type(self).__name__, **event)
 
     def _flush_impl(self) -> int:
         """Apply the queued window on device; returns messages applied."""
@@ -1005,6 +1032,13 @@ class StringServingEngine(ServingEngineBase):
         self.metrics.inc("ops_flushed", n_ok)
         elapsed_ms = (time.perf_counter() - t0) * 1000
         self.metrics.observe("flush_ms", elapsed_ms)
+        tracing.TRACER.record_complete(
+            "serving.ingest_planes", elapsed_ms, ops=int(n_ok),
+            nacked=int(nacked.sum()),
+            seq_ms=(_t_seq - t0) * 1000,
+            pack_ms=st.get("pack_ms", 0.0),
+            dispatch_ms=st.get("dispatch_ms", 0.0),
+            log_ms=(_t_log - _t_apply) * 1000)
         self._watch_apply(elapsed_ms, "ingest_planes", n_ok)
         if compact_due:
             self._flushes_since_compact = 0
@@ -1587,6 +1621,9 @@ class MapServingEngine(ServingEngineBase):
         self.metrics.inc("ops_flushed", n_ok)
         elapsed_ms = (time.perf_counter() - t0) * 1000
         self.metrics.observe("flush_ms", elapsed_ms)
+        tracing.TRACER.record_complete(
+            "serving.ingest_planes", elapsed_ms, ops=int(n_ok),
+            nacked=int(nacked.sum()))
         self._watch_apply(elapsed_ms, "ingest_planes", n_ok)
         return {"seq": seq_rs, "nacked": int(nacked.sum())}
 
@@ -2691,7 +2728,14 @@ class TreeServingEngine(ServingEngineBase):
         self.metrics.observe("ingest_dispatch_ms",
                              (_t_apply - _t_prep) * 1000)
         self.metrics.observe("ingest_log_ms", (_t_log - _t_apply) * 1000)
-        self.metrics.observe("flush_ms", (time.perf_counter() - t0) * 1000)
+        elapsed_ms = (time.perf_counter() - t0) * 1000
+        self.metrics.observe("flush_ms", elapsed_ms)
+        tracing.TRACER.record_complete(
+            "serving.ingest_records", elapsed_ms, ops=int(n_ok),
+            nacked=int(nacked.sum()),
+            seq_ms=(_t_seq - t0) * 1000,
+            dispatch_ms=(_t_apply - _t_prep) * 1000,
+            log_ms=(_t_log - _t_apply) * 1000)
         return {"seq": out_seq, "nacked": int(nacked.sum())}
 
     def ingest_batch(self, doc_ids: List[str], clients, client_seqs,

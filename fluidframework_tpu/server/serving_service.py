@@ -24,7 +24,8 @@ import numpy as np
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..ops.string_store import TensorStringStore
 from ..runtime.remote_message_processor import RemoteMessageProcessor
-from ..utils.telemetry import MetricsCollector, TelemetryLogger
+from ..utils import tracing
+from ..utils.telemetry import MetricsCollector, REGISTRY, TelemetryLogger
 from .tinylicious import LocalService
 
 
@@ -48,6 +49,7 @@ class ServingLocalService(LocalService):
         self._doc_min_seq: Dict[str, int] = {}
         self._flushes_since_compact = 0
         self.metrics = MetricsCollector()
+        REGISTRY.attach("servingService", self.metrics)
         self.telemetry = TelemetryLogger(None, "servingService")
         # channels the replica could NOT admit (store rows exhausted):
         # the ordering service still serves them — only device reads are
@@ -121,7 +123,15 @@ class ServingLocalService(LocalService):
             # deliver message N+1 to the replica before N finishes
             # dispatching — the device merge needs strict seq order
             self._replica_queue.sort(key=lambda rm: rm[1].seq)
-            self.store.apply_messages(self._replica_queue)
+            parent = getattr(self._replica_queue[-1][1], "trace", None)
+            with tracing.span("replica.flush", parent=parent,
+                              ops=n) as sp:
+                self.store.apply_messages(self._replica_queue)
+                st = getattr(self.store, "last_apply_stats", None)
+                if st:
+                    sp.annotate(**st)
+            self.metrics.inc("replica_flushes")
+            self.metrics.inc("replica_ops_applied", n)
             self._replica_queue.clear()
             self._flushes_since_compact += 1
             if self._flushes_since_compact >= self.compact_every:

@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..core.protocol import (
     MessageType, SequencedDocumentMessage, SignalMessage,
 )
+from ..utils import tracing
 from .deli import DeliSequencer, Nack
 from .oplog import PartitionedLog, partition_of
 from .services import Broadcaster, Historian, Scribe, Scriptorium
@@ -143,24 +144,35 @@ class LocalService:
     def _ingest(self, doc_id, client_id, client_seq, ref_seq, type, contents,
                 address) -> None:
         p = partition_of(doc_id, self.raw_log.n_partitions)
+        # trace context rides the raw-log record out of band of contents:
+        # the deli consumer may run on another thread (or after a spill
+        # replay), where the submitting thread's context is gone
         self.raw_log.append(p, dict(
             doc_id=doc_id, client_id=client_id, client_seq=client_seq,
             ref_seq=ref_seq, type=int(type), contents=contents,
-            address=address))
+            address=address, trace=tracing.current_wire()))
 
     def _deli_consume(self, partition: int, offset: int, raw: dict) -> None:
         with self._lock:
-            msg, nack = self.deli.sequence(
-                raw["doc_id"], raw["client_id"], raw["client_seq"],
-                raw["ref_seq"], MessageType(raw["type"]), raw["contents"],
-                raw.get("address"))
-            if nack is not None:
-                self.nacks.append(nack)
-                conn = self._connections.get(nack.client_id)
-                if conn is not None:
-                    conn.nacks.append(nack)
-                return
-            self._publish(msg)
+            with tracing.span("deli.sequence", parent=raw.get("trace"),
+                              doc=raw["doc_id"]) as sp:
+                msg, nack = self.deli.sequence(
+                    raw["doc_id"], raw["client_id"], raw["client_seq"],
+                    raw["ref_seq"], MessageType(raw["type"]),
+                    raw["contents"], raw.get("address"))
+                if nack is not None:
+                    sp.annotate(nacked=int(nack.reason))
+                    self.nacks.append(nack)
+                    conn = self._connections.get(nack.client_id)
+                    if conn is not None:
+                        conn.nacks.append(nack)
+                    return
+                sp.annotate(seq=msg.seq)
+                # hand the deli span to downstream layers: broadcast /
+                # storage / serving-apply spans parent under it
+                if sp.ctx is not None:
+                    msg.trace = sp.ctx.to_wire()
+                self._publish(msg)
 
     def _publish(self, msg: SequencedDocumentMessage) -> None:
         p = partition_of(msg.doc_id, self.deltas_log.n_partitions)
@@ -168,9 +180,15 @@ class LocalService:
 
     def _deltas_consume(self, partition: int, offset: int,
                         msg: SequencedDocumentMessage) -> None:
-        self.scriptorium.store(msg)
-        ack = self.scribe.process(msg)
-        self.broadcaster.publish(msg)
+        with tracing.span("serving.apply", parent=msg.trace,
+                          doc=msg.doc_id, seq=msg.seq) as sp:
+            # re-stamp: broadcast listeners (the client ack path, the
+            # serving replica) parent under the apply span, not deli's
+            if sp.ctx is not None:
+                msg.trace = sp.ctx.to_wire()
+            self.scriptorium.store(msg)
+            ack = self.scribe.process(msg)
+            self.broadcaster.publish(msg)
         if ack is not None:
             ack_type, contents = ack
             with self._lock:

@@ -102,6 +102,7 @@ def msg_to_wire(msg: SequencedDocumentMessage) -> dict:
         "seq": msg.seq, "min_seq": msg.min_seq, "type": int(msg.type),
         "contents": msg.contents, "metadata": msg.metadata,
         "address": msg.address, "timestamp": msg.timestamp,
+        "trace": msg.trace,
     }
 
 
@@ -111,7 +112,8 @@ def msg_from_wire(d: dict) -> SequencedDocumentMessage:
         client_seq=d["client_seq"], ref_seq=d["ref_seq"], seq=d["seq"],
         min_seq=d["min_seq"], type=MessageType(d["type"]),
         contents=d.get("contents"), metadata=d.get("metadata"),
-        address=d.get("address"), timestamp=d.get("timestamp"))
+        address=d.get("address"), timestamp=d.get("timestamp"),
+        trace=d.get("trace"))
 
 
 def nack_to_wire(nack: Nack) -> dict:

@@ -32,6 +32,7 @@ Drills:
 
 from __future__ import annotations
 
+import functools
 import os
 import random
 import string as _string
@@ -39,11 +40,34 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..core.protocol import MessageType
+from ..utils import flight_recorder
 from ..utils.faultpoints import (
     SITE_APPLY_STALL, SITE_CHECKPOINT_MID_WRITE, SITE_DELI_MID_WINDOW,
     SITE_FLUSH_MID_BATCH, SITE_OPLOG_MID_APPEND, SITE_OPLOG_MID_SPILL,
     SITE_SUBMIT_POST_SEQUENCE, CrashInjected, armed,
 )
+
+
+def _recorded_drill(fn):
+    """A drill whose invariant assertion fails dumps the flight recorder
+    first — the post-mortem (recent telemetry, spans in flight, the
+    faultpoint that fired) rides along with the AssertionError instead of
+    dying with the process."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except AssertionError as e:
+            flight_recorder.note("drill_assertion_failed",
+                                 drill=fn.__name__, error=str(e)[:500])
+            try:
+                flight_recorder.dump(f"drill:{fn.__name__}",
+                                     extra={"drill": fn.__name__,
+                                            "error": str(e)[:500]})
+            except OSError:
+                pass
+            raise
+    return wrapper
 
 FAMILIES = ("string", "map", "matrix", "tree")
 
@@ -219,6 +243,7 @@ def logged_ops(engine) -> List[Any]:
 
 # ---------------------------------------------------------------- drills
 
+@_recorded_drill
 def run_crash_drill(seed: int, family: Optional[str] = None,
                     site: Optional[str] = None) -> dict:
     """One full crash-restart drill. Seeded end to end; returns a report
@@ -329,6 +354,7 @@ def run_crash_drill(seed: int, family: Optional[str] = None,
             "crashed_at": crashed_at}
 
 
+@_recorded_drill
 def run_spill_drill(seed: int, spill_dir: str) -> dict:
     """Kill the engine mid-JSONL-spill-line; recover the log FROM DISK.
     The torn tail must be dropped and truncated, every fully-written
@@ -380,6 +406,7 @@ def run_spill_drill(seed: int, spill_dir: str) -> dict:
             "recovered": len(rec_msgs)}
 
 
+@_recorded_drill
 def run_checkpoint_drill(seed: int, path: str) -> dict:
     """Kill the sequencer mid-checkpoint-write. The PREVIOUS checkpoint
     file must survive byte-identically (tmp + fsync + rename), and a
@@ -417,6 +444,7 @@ def run_checkpoint_drill(seed: int, path: str) -> dict:
     return {"seed": seed}
 
 
+@_recorded_drill
 def run_stall_drill(seed: int, family: str = "string",
                     stall_s: float = 0.05) -> dict:
     """Inject a device-apply stall; the engine watchdog must count it,

@@ -1,0 +1,118 @@
+"""Trace viewer: render a span tree from a Chrome trace-event dump.
+
+Reference counterpart: the reference service reads its correlation-id
+logs in Kibana; here the same story is a text renderer over the tracer's
+Chrome trace-event JSON (``utils.tracing.Tracer.export_chrome``) — one
+indented line per span, with duration and the layer-attached args — so a
+captured op batch reads as::
+
+    outbox.flush                     0.42ms  ops=3
+      wire.submit                    0.11ms
+        deli.sequence                0.08ms  seq=7
+          serving.apply              0.15ms  seq=7
+            ack                      0.03ms  seq=7
+
+Usage::
+
+    python -m fluidframework_tpu.tools.trace_viewer dump.json
+    python -m fluidframework_tpu.tools.trace_viewer dump.json --list
+    python -m fluidframework_tpu.tools.trace_viewer dump.json --trace <id>
+
+Accepts either the Chrome form ({"traceEvents": [...]}) or a bare list
+of tracer events; the live tracer can be rendered directly with
+``render_tracer()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, Iterable, List, Optional
+
+from ..utils import tracing
+
+
+def load_events(path: str) -> List[dict]:
+    """Span events from a trace dump — Chrome ({"traceEvents": [...]})
+    or a bare event list."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", [])
+    return doc
+
+
+def trace_ids(events: Iterable[dict]) -> List[str]:
+    """Distinct trace ids, oldest first."""
+    seen: Dict[str, None] = {}
+    for e in events:
+        a = e.get("args") or {}
+        tid = e.get("trace_id", a.get("trace_id"))
+        if tid is not None:
+            seen.setdefault(tid, None)
+    return list(seen)
+
+
+def render(events: Iterable[dict], trace_id: Optional[str] = None,
+           width: int = 34) -> str:
+    """The span tree(s) as indented text, one line per span."""
+    lines: List[str] = []
+    for root in tracing.span_tree(events, trace_id):
+        _render_node(root, 0, lines, width)
+    return "\n".join(lines)
+
+
+def _render_node(node: dict, depth: int, lines: List[str],
+                 width: int) -> None:
+    label = "  " * depth + node["name"]
+    dur_ms = (node["dur"] or 0.0) / 1e3
+    args = " ".join(f"{k}={_fmt(v)}" for k, v in
+                    sorted(node["args"].items()))
+    lines.append(f"{label:<{width}} {dur_ms:8.2f}ms"
+                 + (f"  {args}" if args else ""))
+    for child in node["children"]:
+        _render_node(child, depth + 1, lines, width)
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:.2f}"
+    return str(v)
+
+
+def render_tracer(tracer: Optional[tracing.Tracer] = None,
+                  trace_id: Optional[str] = None) -> str:
+    """Render straight from a live tracer ring (default: the process
+    tracer) — the REPL/bench path, no dump file needed."""
+    t = tracer if tracer is not None else tracing.TRACER
+    return render(t.events(trace_id))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a tracing dump as an indented span tree")
+    ap.add_argument("dump", help="Chrome trace-event JSON "
+                    "(utils.tracing export) or bare event list")
+    ap.add_argument("--trace", help="render only this trace id")
+    ap.add_argument("--list", action="store_true",
+                    help="list trace ids and span counts, render nothing")
+    args = ap.parse_args(argv)
+    events = load_events(args.dump)
+    if args.list:
+        for tid in trace_ids(events):
+            n = sum(1 for e in events
+                    if (e.get("trace_id",
+                              (e.get("args") or {}).get("trace_id"))) == tid)
+            print(f"{tid}  ({n} spans)")
+        return 0
+    out = render(events, args.trace)
+    if out:
+        print(out)
+    else:
+        print("(no spans)" if not events else
+              f"(no spans for trace {args.trace})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -73,10 +73,26 @@ def active_plan():
 
 def fault_point(site: str, **ctx) -> None:
     """The hook server code calls. Disarmed: one global read, no other
-    work. Armed: the plan decides (crash / stall / nothing)."""
+    work. Armed: the plan decides (crash / stall / nothing). When the
+    plan FIRES (raises — an injected crash), the flight recorder dumps
+    its ring to JSONL first, so every simulated kill leaves the same
+    structured evidence a production crash handler would."""
     plan = _plan
     if plan is not None:
-        plan.hit(site, **ctx)
+        try:
+            plan.hit(site, **ctx)
+        except BaseException as e:
+            from . import flight_recorder
+            flight_recorder.note(
+                "faultpoint_fired", site=site, error=repr(e),
+                **{k: v for k, v in ctx.items()
+                   if isinstance(v, (int, float, str, bool))})
+            try:
+                flight_recorder.dump(f"faultpoint:{site}",
+                                     extra={"site": site})
+            except OSError:
+                pass  # evidence is best-effort; the crash must proceed
+            raise
 
 
 class armed:

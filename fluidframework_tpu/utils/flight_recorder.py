@@ -1,0 +1,142 @@
+"""Flight recorder: a bounded ring of recent telemetry, dumped on crash.
+
+Reference counterpart: the black-box/flight-recorder pattern behind
+production incident tooling (the reference service keeps recent structured
+logs hot so an Alfred/Deli crash ships context, not just a stack trace).
+Here: every telemetry event (``utils.telemetry`` routes ``send`` through
+:func:`record`), tracer span, and faultpoint hit lands in a fixed-size
+ring; when a faultpoint fires (``utils.faultpoints``) or a chaos drill
+assertion fails (``testing.chaos``), the ring is dumped to JSONL so the
+post-mortem has the last N events that led to the failure — structured
+evidence instead of assertion text (ISSUE 2 / PR 1 follow-up).
+
+The recorder is process-wide and always on: recording is one bounded
+``deque.append`` per event, dumping happens only on failure. Dump files
+rotate within a small window (``max_dumps``) so repeated drill crashes in
+a test run cannot fill the disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort JSON coercion for dump lines (events may carry file
+    handles, numpy scalars, exceptions — the dump must never fail)."""
+    try:
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        return repr(value)
+
+
+class FlightRecorder:
+    """Bounded ring buffer of telemetry events + JSONL crash dumps."""
+
+    def __init__(self, capacity: int = 4096, dump_dir: Optional[str] = None,
+                 max_dumps: int = 64):
+        self.capacity = capacity
+        self.enabled = True
+        self.max_dumps = max_dumps
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._dump_seq = 0
+        self._dump_dir = dump_dir
+        #: paths written by :meth:`dump`, newest last (tests/operators
+        #: read ``dumps[-1]`` to find the evidence file)
+        self.dumps: List[str] = []
+
+    # ------------------------------------------------------------ recording
+
+    def record(self, event: Dict[str, Any]) -> None:
+        """Append one event dict to the ring (cheap; no copy of values)."""
+        if self.enabled:
+            self._ring.append({"ts": time.time(), **event})
+
+    def note(self, name: str, **props: Any) -> None:
+        """Record an ad-hoc named event (non-telemetry callers: faultpoint
+        hits, drill failures, watchdog stalls)."""
+        self.record({"eventName": name, **props})
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # -------------------------------------------------------------- dumping
+
+    @property
+    def dump_dir(self) -> str:
+        return (self._dump_dir or os.environ.get("FLUID_FLIGHT_DIR")
+                or tempfile.gettempdir())
+
+    def dump(self, reason: str, path: Optional[str] = None,
+             extra: Optional[dict] = None) -> str:
+        """Write the ring to JSONL: one header line (reason, wall time,
+        event count), then one line per event, oldest first. Returns the
+        path. Default paths rotate modulo ``max_dumps`` per process."""
+        with self._lock:
+            events = list(self._ring)
+            if path is None:
+                name = (f"flight-{os.getpid()}-"
+                        f"{self._dump_seq % self.max_dumps}.jsonl")
+                path = os.path.join(self.dump_dir, name)
+            self._dump_seq += 1
+        header = {"flight_recorder": reason, "dumped_at": time.time(),
+                  "n_events": len(events), **(extra or {})}
+        with open(path, "w") as f:
+            f.write(json.dumps(
+                {k: _jsonable(v) for k, v in header.items()}) + "\n")
+            for e in events:
+                f.write(json.dumps(
+                    {k: _jsonable(v) for k, v in e.items()}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        with self._lock:
+            self.dumps.append(path)
+            del self.dumps[:-self.max_dumps]
+        return path
+
+
+#: the process-wide recorder (telemetry/faultpoints/chaos all feed it)
+RECORDER = FlightRecorder()
+
+
+def record(event: Dict[str, Any]) -> None:
+    RECORDER.record(event)
+
+
+def note(name: str, **props: Any) -> None:
+    RECORDER.note(name, **props)
+
+
+def dump(reason: str, path: Optional[str] = None,
+         extra: Optional[dict] = None) -> str:
+    return RECORDER.dump(reason, path, extra)
+
+
+def load_dump(path: str) -> List[dict]:
+    """Read a dump back: list of dicts, header first (trace_viewer and
+    tests use this; tolerant of a torn tail the same way oplog recovery
+    is — a crash mid-dump keeps the complete prefix)."""
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                break
+    return out

@@ -7,17 +7,27 @@ Reference counterpart: ``@fluidframework/telemetry-utils`` —
 host-provided ``ITelemetryBaseLogger``); span taxonomy mirrors the
 reference's hot paths: ``load`` / ``catchup`` / ``opApply`` / ``summarize``.
 
-TPU-first addition (§5.5): ``MetricsCollector`` — per-step counters and
-latency histograms (ops merged, docs touched, p50/p99 apply latency)
-exported from the host loop, the role Prometheus metrics play server-side
-in the reference.
+TPU-first addition (§5.5): ``MetricsRegistry`` — a process-wide registry of
+counters, gauges, and latency histograms with Prometheus-style text
+exposition, the role Prometheus metrics play server-side in the reference.
+``MetricsCollector`` (the historical per-engine name) is the same class;
+per-component collectors ``attach`` to the global :data:`REGISTRY` so one
+``snapshot()``/``render_prometheus()`` covers the whole process (ISSUE 2).
+
+Every event sent through a :class:`TelemetryLogger` — sink or no sink —
+is also recorded into the process flight recorder
+(``utils.flight_recorder``), so a crash dump carries the recent telemetry
+stream of every layer.
 """
 
 from __future__ import annotations
 
 import bisect
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional
+
+from . import flight_recorder as _flight
 
 # event categories (reference: ITelemetryBaseEvent.category)
 GENERIC = "generic"
@@ -48,12 +58,14 @@ class TelemetryLogger:
         return TelemetryLogger(self._sink, ns, {**self.props, **(props or {})})
 
     def send(self, category: str, event_name: str, **props) -> None:
-        if self._sink is None:
-            return
         name = f"{self.namespace}:{event_name}" if self.namespace \
             else event_name
-        self._sink({"category": category, "eventName": name,
-                    **self.props, **props})
+        event = {"category": category, "eventName": name,
+                 **self.props, **props}
+        # every event — sinked or not — feeds the crash flight recorder
+        _flight.record(event)
+        if self._sink is not None:
+            self._sink(event)
 
     def send_event(self, event_name: str, **props) -> None:
         self.send(GENERIC, event_name, **props)
@@ -119,10 +131,18 @@ class SampledTelemetry:
         self.rate = rate
         self.count = 0
         self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
 
     def record(self, value: float = 1.0) -> None:
         self.count += 1
         self.total += value
+        # track extremes so outliers (a 983 ms stall in a 1000-sample
+        # window) survive aggregation instead of vanishing into the mean
+        if self.min is None or value < self.min:
+            self.min = value
+        if self.max is None or value > self.max:
+            self.max = value
         if self.count >= self.rate:
             self.flush()
 
@@ -130,9 +150,23 @@ class SampledTelemetry:
         if self.count:
             self.logger.send(PERFORMANCE, self.event_name,
                              samples=self.count, total=self.total,
-                             mean=self.total / self.count)
+                             mean=self.total / self.count,
+                             min=self.min, max=self.max)
             self.count = 0
             self.total = 0.0
+            self.min = None
+            self.max = None
+
+    def close(self) -> None:
+        """Flush any partial window (call on shutdown — a tail of
+        ``count < rate`` records would otherwise be lost)."""
+        self.flush()
+
+    def __enter__(self) -> "SampledTelemetry":
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.close()
 
 
 class Histogram:
@@ -150,7 +184,10 @@ class Histogram:
         self.n += 1
 
     def percentile(self, p: float) -> float:
-        """Upper bound of the bucket containing the p-th percentile."""
+        """Upper bound of the bucket containing the p-th percentile.
+        Returns ``inf`` when the percentile lands in the open-ended
+        overflow bucket — check :attr:`overflow` to see how many values
+        exceeded the last bound."""
         if self.n == 0:
             return 0.0
         target = p / 100.0 * self.n
@@ -162,31 +199,144 @@ class Histogram:
                     else float("inf")
         return float("inf")
 
+    @property
+    def overflow(self) -> int:
+        """Count of recorded values past the last bucket bound (the
+        values ``percentile`` reports as ``inf``)."""
+        return self.counts[-1]
 
-class MetricsCollector:
-    """Host-loop counters + latency histograms (SURVEY.md §5.5): the
-    client-side analog of the reference server's per-lambda Prometheus
-    metrics (op rate, lag, pending ops)."""
+
+class MetricsRegistry:
+    """Process-wide counters, gauges, and latency histograms (SURVEY.md
+    §5.5): the analog of the reference server's per-lambda Prometheus
+    metrics (op rate, lag, pending ops), with Prometheus-style text
+    exposition. Component-local instances (one per serving engine)
+    ``attach`` to the module's global :data:`REGISTRY` so one snapshot
+    covers the whole process."""
 
     def __init__(self):
         self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, Histogram] = {}
+        # name -> weakref to an attached component registry: engines come
+        # and go (tests build hundreds); the global registry must not
+        # keep them alive
+        self._components: Dict[str, Any] = {}
+
+    # ----------------------------------------------------------- recording
 
     def inc(self, name: str, by: float = 1.0) -> None:
         self.counters[name] = self.counters.get(name, 0.0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
 
     def observe(self, name: str, value_ms: float) -> None:
         if name not in self.histograms:
             self.histograms[name] = Histogram()
         self.histograms[name].record(value_ms)
 
+    # ---------------------------------------------------------- components
+
+    def attach(self, name: str, registry: "MetricsRegistry") -> str:
+        """Register a component-local registry under ``name`` for global
+        exposition; auto-suffixes on collision (several engines of the
+        same family in one process). Returns the name used."""
+        base, i = name, 1
+        while True:
+            ref = self._components.get(name)
+            if ref is None or ref() is None or ref() is registry:
+                break
+            i += 1
+            name = f"{base}{i}"
+        self._components[name] = weakref.ref(registry)
+        return name
+
+    def components(self) -> Dict[str, "MetricsRegistry"]:
+        live = {}
+        for name, ref in list(self._components.items()):
+            reg = ref()
+            if reg is None:
+                del self._components[name]
+            else:
+                live[name] = reg
+        return live
+
+    # ------------------------------------------------------------ snapshot
+
     def snapshot(self) -> dict:
+        """Flat dict: counters verbatim, gauges verbatim, and per-
+        histogram ``_p50_ms``/``_p99_ms``/``_count``/``_overflow``."""
         out: Dict[str, Any] = dict(self.counters)
+        out.update(self.gauges)
         for name, h in self.histograms.items():
             out[f"{name}_p50_ms"] = h.percentile(50)
             out[f"{name}_p99_ms"] = h.percentile(99)
             out[f"{name}_count"] = h.n
+            out[f"{name}_overflow"] = h.overflow
         return out
+
+    def full_snapshot(self) -> dict:
+        """Own snapshot + every live attached component's, prefixed
+        ``{component}.{metric}`` — the process-wide metric set bench.py
+        embeds in BENCH json."""
+        out = self.snapshot()
+        for name, reg in self.components().items():
+            for k, v in reg.snapshot().items():
+                out[f"{name}.{k}"] = v
+        return out
+
+    def render_prometheus(self, include_components: bool = True) -> str:
+        """Prometheus text exposition (counters/gauges as single samples,
+        histograms as ``_bucket``/``_sum``-less cumulative bucket lines —
+        bounds are upper edges in ms, ``+Inf`` is the overflow bucket)."""
+        lines: List[str] = []
+
+        def emit(prefix: str, reg: "MetricsRegistry") -> None:
+            lab = f'{{component="{prefix}"}}' if prefix else ""
+            comp = f'component="{prefix}",' if prefix else ""
+            for k in sorted(reg.counters):
+                lines.append(f"# TYPE {_prom_name(k)} counter")
+                lines.append(f"{_prom_name(k)}{lab} {reg.counters[k]}")
+            for k in sorted(reg.gauges):
+                lines.append(f"# TYPE {_prom_name(k)} gauge")
+                lines.append(f"{_prom_name(k)}{lab} {reg.gauges[k]}")
+            for k in sorted(reg.histograms):
+                h = reg.histograms[k]
+                name = _prom_name(k)
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for bound, c in zip(h.bounds, h.counts):
+                    cum += c
+                    lines.append(
+                        f'{name}_bucket{{{comp}le="{bound:g}"}} {cum}')
+                lines.append(f'{name}_bucket{{{comp}le="+Inf"}} {h.n}')
+                lines.append(f"{name}_count{lab} {h.n}")
+
+        emit("", self)
+        if include_components:
+            for cname, reg in sorted(self.components().items()):
+                emit(cname, reg)
+        return "\n".join(lines) + "\n"
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a metric name for Prometheus exposition."""
+    return "".join(ch if ch.isalnum() or ch == "_" else "_"
+                   for ch in name)
+
+
+#: back-compat name — per-engine collectors ARE registries
+MetricsCollector = MetricsRegistry
+
+#: the process-wide registry: dark-layer instrumentation (oplog,
+#: summarizer, container runtime, kernels, ingress) counts here, and
+#: component registries attach for unified exposition
+REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return REGISTRY
 
 
 def console_sink(event: dict) -> None:

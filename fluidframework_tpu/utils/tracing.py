@@ -1,0 +1,366 @@
+"""End-to-end op tracing: per-batch span trees across the whole stack.
+
+Reference counterpart: the distributed-tracing discipline behind the
+reference service's correlation ids (Alfred stamps a correlation id per
+socket message; every lambda logs against it) — here grown into real
+spans: a :class:`TraceContext` (trace id + span id) is attached to an op
+batch at the client outbox, rides the wire (op frames / raw-log records /
+``SequencedDocumentMessage.trace``) through ingress, Deli sequencing,
+serving apply, and the broadcast ack, and every layer opens a host-timed
+span (built on ``telemetry.PerformanceEvent``) under its parent. The
+result is a per-batch span tree — outbox → wire → deli → apply → ack —
+exportable as Chrome trace-event JSON (``chrome://tracing`` / Perfetto)
+and renderable as text by ``tools.trace_viewer``.
+
+Spans are recorded into a process-wide bounded ring (:data:`TRACER`);
+within a process, parentage flows implicitly through a thread-local
+context stack, so nested layers need no plumbing; across process/socket
+hops the context is serialized with :meth:`TraceContext.to_wire` (a
+2-key dict) and re-attached with :func:`attach` on the far side.
+
+Span start/end events also flow through the tracer's
+:class:`~fluidframework_tpu.utils.telemetry.TelemetryLogger`, which means
+they land in the crash flight recorder — a dump shows the spans in
+flight when a faultpoint fired.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+from .telemetry import PerformanceEvent, TelemetryLogger
+
+
+class TraceContext:
+    """One node of a span tree: (trace_id, span_id). Serializes to a
+    2-key dict for wire frames and log records."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    def to_wire(self) -> dict:
+        return {"tid": self.trace_id, "sid": self.span_id}
+
+    @staticmethod
+    def from_wire(d: Any) -> Optional["TraceContext"]:
+        if isinstance(d, dict) and "tid" in d and "sid" in d:
+            return TraceContext(d["tid"], d["sid"])
+        return None
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.trace_id}, {self.span_id})"
+
+
+class Span:
+    """A timed span, used as a context manager. While entered, it is the
+    thread's current context: child spans and ``current_wire()`` parent
+    to it. Timing is delegated to ``PerformanceEvent`` (the span emits
+    the reference ``_start``/``_end``/``_cancel`` telemetry events)."""
+
+    def __init__(self, tracer: "Tracer", name: str, ctx: TraceContext,
+                 parent_id: Optional[int], args: Dict[str, Any]):
+        self.tracer = tracer
+        self.name = name
+        self.ctx = ctx
+        self.parent_id = parent_id
+        self.args = args
+        self._pe = PerformanceEvent(
+            tracer.logger, name,
+            {"trace_id": ctx.trace_id, "span_id": ctx.span_id})
+        self._ts_us: Optional[float] = None
+
+    def annotate(self, **args: Any) -> "Span":
+        """Attach args after entry (device-dispatch counters measured
+        inside the span)."""
+        self.args.update(args)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._ts_us = time.time() * 1e6
+        self._pe.__enter__()
+        self.tracer._push(self.ctx)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.tracer._pop()
+        self._pe.__exit__(exc_type, exc, tb)
+        event = {
+            "name": self.name,
+            "trace_id": self.ctx.trace_id,
+            "span_id": self.ctx.span_id,
+            "parent_id": self.parent_id,
+            "ts": self._ts_us,
+            "dur": (self._pe.duration_ms or 0.0) * 1e3,  # µs
+            "tid": threading.get_ident(),
+            "args": self.args,
+        }
+        if exc is not None:
+            event["error"] = repr(exc)
+        self.tracer._record(event)
+
+
+class _NullSpan:
+    """Disabled-tracer stand-in: same surface, no recording."""
+
+    ctx = None
+    args: Dict[str, Any] = {}
+
+    def annotate(self, **_args: Any) -> "_NullSpan":
+        return self
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class Tracer:
+    """Process-wide span recorder: a bounded ring of completed span
+    events plus a thread-local current-context stack."""
+
+    def __init__(self, capacity: int = 65536):
+        self.enabled = True
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self._span_ids = itertools.count(1)
+        self._trace_ids = itertools.count(1)
+        self._local = threading.local()
+        #: spans mirror their start/end through this logger (no sink by
+        #: default — events still reach the flight recorder)
+        self.logger = TelemetryLogger(None, "trace")
+        self._sample_counters: Dict[str, int] = {}
+
+    # ----------------------------------------------------------- id issue
+
+    def new_trace_id(self) -> str:
+        return f"{os.getpid():x}.{next(self._trace_ids):x}"
+
+    # ---------------------------------------------------- context plumbing
+
+    def _stack(self) -> List[TraceContext]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _push(self, ctx: TraceContext) -> None:
+        self._stack().append(ctx)
+
+    def _pop(self) -> None:
+        stack = self._stack()
+        if stack:
+            stack.pop()
+
+    def current(self) -> Optional[TraceContext]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    # ------------------------------------------------------------ spanning
+
+    def span(self, name: str, parent: Optional[Any] = None,
+             **args: Any) -> Any:
+        """Open a span. ``parent`` may be a :class:`TraceContext`, a wire
+        dict (``{"tid", "sid"}``), or None — None parents to the thread's
+        current span, or starts a new trace at the root."""
+        if not self.enabled:
+            return _NULL
+        if parent is None:
+            parent = self.current()
+        elif not isinstance(parent, TraceContext):
+            parent = TraceContext.from_wire(parent) or self.current()
+        if parent is None:
+            ctx = TraceContext(self.new_trace_id(), next(self._span_ids))
+            parent_id = None
+        else:
+            ctx = TraceContext(parent.trace_id, next(self._span_ids))
+            parent_id = parent.span_id
+        return Span(self, name, ctx, parent_id, args)
+
+    def maybe_root_span(self, name: str, every: int = 1024,
+                        **args: Any) -> Any:
+        """Sampled root span for server-only hot paths (no client trace
+        upstream): opens a real span when a trace is already current, or
+        on every ``every``-th call — so bench/serving loops yield a few
+        representative timelines without per-op overhead."""
+        if not self.enabled:
+            return _NULL
+        if self.current() is not None:
+            return self.span(name, **args)
+        n = self._sample_counters.get(name, 0)
+        self._sample_counters[name] = n + 1
+        if n % every == 0:
+            return self.span(name, **args)
+        return _NULL
+
+    # ----------------------------------------------------------- recording
+
+    def _record(self, event: dict) -> None:
+        self._events.append(event)
+
+    def record_complete(self, name: str, dur_ms: float,
+                        parent: Optional[Any] = None,
+                        **args: Any) -> Optional[TraceContext]:
+        """Record an already-measured span (hot batch paths that time
+        themselves): one ring append, no context-manager overhead. The
+        span is stamped as ending now. Returns its context (or None when
+        disabled)."""
+        if not self.enabled:
+            return None
+        if parent is None:
+            parent = self.current()
+        elif not isinstance(parent, TraceContext):
+            parent = TraceContext.from_wire(parent) or self.current()
+        if parent is None:
+            ctx = TraceContext(self.new_trace_id(), next(self._span_ids))
+            parent_id = None
+        else:
+            ctx = TraceContext(parent.trace_id, next(self._span_ids))
+            parent_id = parent.span_id
+        now_us = time.time() * 1e6
+        self._record({
+            "name": name, "trace_id": ctx.trace_id,
+            "span_id": ctx.span_id, "parent_id": parent_id,
+            "ts": now_us - dur_ms * 1e3, "dur": dur_ms * 1e3,
+            "tid": threading.get_ident(), "args": args,
+        })
+        return ctx
+
+    def events(self, trace_id: Optional[str] = None) -> List[dict]:
+        evs = list(self._events)
+        if trace_id is not None:
+            evs = [e for e in evs if e["trace_id"] == trace_id]
+        return evs
+
+    def trace_ids(self) -> List[str]:
+        """Distinct trace ids in the ring, oldest first."""
+        seen: Dict[str, None] = {}
+        for e in self._events:
+            seen.setdefault(e["trace_id"], None)
+        return list(seen)
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    # ------------------------------------------------------------- export
+
+    def export_chrome(self, path: Optional[str] = None,
+                      trace_id: Optional[str] = None) -> dict:
+        """Chrome trace-event JSON (``"ph": "X"`` complete events, µs
+        timestamps) — loadable in chrome://tracing / Perfetto and by
+        ``tools.trace_viewer``. Writes to ``path`` when given."""
+        doc = {"traceEvents": [chrome_event(e)
+                               for e in self.events(trace_id)]}
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
+
+
+def chrome_event(e: dict) -> dict:
+    return {
+        "ph": "X", "name": e["name"], "cat": "op",
+        "ts": e["ts"], "dur": e["dur"],
+        "pid": os.getpid(), "tid": e["tid"],
+        "args": {"trace_id": e["trace_id"], "span_id": e["span_id"],
+                 "parent_id": e["parent_id"],
+                 **{k: _arg(v) for k, v in e.get("args", {}).items()},
+                 **({"error": e["error"]} if "error" in e else {})},
+    }
+
+
+def _arg(v: Any) -> Any:
+    return v if isinstance(v, (int, float, str, bool, type(None))) \
+        else repr(v)
+
+
+#: the process tracer — all layers record here
+TRACER = Tracer()
+
+
+def span(name: str, parent: Optional[Any] = None, **args: Any) -> Any:
+    return TRACER.span(name, parent, **args)
+
+
+def current() -> Optional[TraceContext]:
+    return TRACER.current()
+
+
+def current_wire() -> Optional[dict]:
+    """The current context as a wire dict, or None — what gets stamped
+    into frames / raw-log records at a serialization boundary."""
+    ctx = TRACER.current()
+    return ctx.to_wire() if ctx is not None else None
+
+
+class attach:
+    """``with attach(wire_dict): ...`` — re-establish a deserialized
+    context as the thread's current (the receiving side of a process or
+    socket hop). A None/invalid dict is a no-op."""
+
+    def __init__(self, wire: Any):
+        self.ctx = TraceContext.from_wire(wire)
+
+    def __enter__(self) -> Optional[TraceContext]:
+        if self.ctx is not None:
+            TRACER._push(self.ctx)
+        return self.ctx
+
+    def __exit__(self, *_exc) -> None:
+        if self.ctx is not None:
+            TRACER._pop()
+
+
+def set_enabled(flag: bool) -> None:
+    TRACER.enabled = flag
+
+
+def span_tree(events: Iterable[dict], trace_id: Optional[str] = None
+              ) -> List[dict]:
+    """Nest flat span events into a tree: each node gets a ``children``
+    list, roots returned in start order. Accepts tracer events or the
+    ``args``-carrying Chrome form (``tools.trace_viewer`` renders both)."""
+    nodes: Dict[int, dict] = {}
+    flat: List[dict] = []
+    for e in events:
+        a = e.get("args") or {}
+        node = {
+            "name": e["name"],
+            "trace_id": e.get("trace_id", a.get("trace_id")),
+            "span_id": e.get("span_id", a.get("span_id")),
+            "parent_id": e.get("parent_id", a.get("parent_id")),
+            "ts": e.get("ts", 0.0),
+            "dur": e.get("dur", 0.0),
+            "args": {k: v for k, v in a.items()
+                     if k not in ("trace_id", "span_id", "parent_id")},
+            "children": [],
+        }
+        if trace_id is not None and node["trace_id"] != trace_id:
+            continue
+        flat.append(node)
+        if node["span_id"] is not None:
+            nodes[node["span_id"]] = node
+    roots: List[dict] = []
+    for node in flat:
+        parent = nodes.get(node["parent_id"]) \
+            if node["parent_id"] is not None else None
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    for n in nodes.values():
+        n["children"].sort(key=lambda c: c["ts"])
+    roots.sort(key=lambda c: c["ts"])
+    return roots
