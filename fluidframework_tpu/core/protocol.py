@@ -34,6 +34,24 @@ class MessageType(enum.IntEnum):
     REJOIN = 8
 
 
+class ColumnarWireKind(enum.IntEnum):
+    """Op kind codes of the columnar binary ingress's fixed-width op
+    records (``server.columnar_ingress``). These are WIRE codes — the
+    ingress maps them to ``ops.schema.OpKind`` plane codes at admission
+    (they happen to coincide today; the separate enum keeps the wire
+    contract explicit so the plane schema can evolve without a silent
+    protocol break).
+
+    INSERT inserts ``texts[tidx]`` at a0; REMOVE removes [a0, a1);
+    ANNOTATE applies the single-key ``props[tidx]`` dict over [a0, a1) —
+    the interval/rich-text op added alongside the device-side anchor
+    slide (rich ``R`` frames only; plain ``B`` frames reject it)."""
+
+    INSERT = 0
+    REMOVE = 1
+    ANNOTATE = 2
+
+
 @dataclasses.dataclass
 class SignalMessage:
     """An ephemeral, non-sequenced broadcast message (reference:

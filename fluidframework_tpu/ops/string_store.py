@@ -171,9 +171,10 @@ def _apply_pallas_jit(state, kind, a0, a1, a2, seq, client, ref_seq,
 @functools.partial(jax.jit,
                    static_argnames=("R", "O", "pos_wide", "ref_wide",
                                     "rich", "n_docs", "fuse_compact",
-                                    "scatter_rows", "compact8"))
+                                    "scatter_rows", "compact8", "tab_n"))
 def _columnar_unpack_jit(buf, R, O, pos_wide, ref_wide, rich, n_docs,
-                         fuse_compact, scatter_rows, compact8=False):
+                         fuse_compact, scatter_rows, compact8=False,
+                         tab_n=0):
     """Device-side unpack of ONE byte-packed columnar batch. The host
     concatenates every op plane into a single uint8 buffer — kind u8,
     client-idx u8, a0/a1 (i16, or i32 when ``pos_wide``), ref (u16 LAG
@@ -188,6 +189,15 @@ def _columnar_unpack_jit(buf, R, O, pos_wide, ref_wide, rich, n_docs,
     seq = base + running count of non-NOOP slots (nacked ops were
     NOOP-masked host-side and consumed no sequence number); ref clamps to
     seq-1 (mirroring Deli).
+
+    ``rich`` payload modes: 0 = broadcast (one i32 handle), 1 = a full
+    (N,) i32 a2 plane, 2/3 = TABLE form — the wire carries a u8 (mode 2)
+    or u16 (mode 3) table index per op plus two small i32 tables
+    (``tab_n`` entries each, padded to a power of two): the a2 value
+    (payload handle / packed property) and the insert length. The device
+    gathers a2 and insert a1 from the tables, so rich batches cost ~1-2
+    extra wire bytes per op instead of 4 and the host never materializes
+    an (R, O) handle plane (the former rich-pack hot spot).
 
     This is deliberately its OWN jit (not fused into the merge program),
     and the buffer is INT32 WORDS unpacked by shift/mask — not u8 +
@@ -234,7 +244,16 @@ def _columnar_unpack_jit(buf, R, O, pos_wide, ref_wide, rich, n_docs,
         a0, off = take_pos(off, N)
         a1, off = take_pos(off, N)
         ref, off = (take_i32 if ref_wide else take_u16)(off, N)
-    a2, off = take_i32(off, N if rich else 1)
+    lenv = None
+    if rich in (2, 3):
+        ti, off = (take_u8 if rich == 2 else take_u16)(off, N)
+        a2tab, off = take_i32(off, tab_n)
+        lentab, off = take_i32(off, tab_n)
+        ti = ti.reshape(R, O)
+        a2 = a2tab[ti]
+        lenv = lentab[ti]
+    else:
+        a2, off = take_i32(off, N if rich else 1)
     base, off = take_i32(off, R)
     rows, off = take_i32(off, R)
     min_seq, off = take_i32(off, n_docs if fuse_compact else 1)
@@ -245,11 +264,16 @@ def _columnar_unpack_jit(buf, R, O, pos_wide, ref_wide, rich, n_docs,
     a0 = a0.reshape(R, O)
     a1 = a1.reshape(R, O)
     client = client.reshape(R, O)
+    if lenv is not None:  # table form: insert a1 = payload length
+        a1 = jnp.where(kind == int(OpKind.STR_INSERT), lenv, a1)
     if ref_wide and not compact8:
         ref = jnp.minimum(ref.reshape(R, O), seq - 1)
     else:  # lag encoding: ref = seq - lag, lag >= 1 (the Deli clamp)
         ref = seq - jnp.maximum(ref.reshape(R, O), 1)
-    a2 = a2.reshape(R, O) if rich else jnp.broadcast_to(a2, (R, O))
+    if rich == 1:
+        a2 = a2.reshape(R, O)
+    elif not rich:
+        a2 = jnp.broadcast_to(a2, (R, O))
     a2 = jnp.where((kind == int(OpKind.STR_INSERT))
                    | (kind == int(OpKind.STR_ANNOTATE)), a2, 0)
     planes = (kind, a0, a1, a2, seq, client, ref)
@@ -306,6 +330,15 @@ class StringOpInterner:
         self._prop_values = ValueInterner()
         self._has_props = False
         self.n_props = n_props
+        # one interner pass per UNIQUE (key, value): columnar annotate
+        # tables re-pack the same few props every batch; the packed plane
+        # <<20 | handle word is cached for hashable values (sound: planes
+        # and value handles minted on the apply path are never released)
+        self._props_pack_cache: Dict[tuple, int] = {}
+        # (rows, client-column, lut) of the last single-writer columnar
+        # batch: steady serving re-interns the same (row, client) pairs
+        # every batch — a 40 KB memcmp replaces R dict hits
+        self._cidx_cache: Optional[tuple] = None
 
     def _client(self, doc: int, client_id: int) -> int:
         m = self._client_idx[doc]
@@ -488,6 +521,8 @@ class TensorStringStore(StringOpInterner):
         self._interval_counter = 0
         #: wire profile of the last columnar batch (None before the first)
         self.last_profile: Optional[tuple] = None
+        #: rich payload wire form of the last batch: "plane"/"tab8"/"tab16"
+        self.last_rich_wire: Optional[str] = None
         #: fused device→host gathers served (the read-path RTT budget)
         self.device_reads = 0
         # highest collaboration-window floor seen per doc (anchor slides
@@ -500,6 +535,10 @@ class TensorStringStore(StringOpInterner):
         # window-floor advance actually dooms a tombstone — only then do
         # interval anchors need sliding at the crossing.
         self._iv_tombs: List[list] = [[] for _ in range(n_docs)]
+        # rows currently holding intervals: the columnar hot path's "does
+        # this batch need crossing bookkeeping at all" check must be O(1),
+        # not a scan of n_docs dicts
+        self._iv_docs: set = set()
 
     # ----------------------------------------------------------------- apply
 
@@ -517,7 +556,7 @@ class TensorStringStore(StringOpInterner):
         collaboration (where MSN advances on nearly every message) still
         take large batched dispatches."""
         msgs = list(messages)
-        iv_docs = {d for d in range(self.n_docs) if self._intervals[d]}
+        iv_docs = self._iv_docs
         if not iv_docs:
             self._apply_batch(msgs)
             return
@@ -573,7 +612,7 @@ class TensorStringStore(StringOpInterner):
 
     def apply_planes(self, rows, kind, a0, a1, seq_base, client_id, ref_seq,
                      text: str = "", min_seq=None, texts=None, tidx=None,
-                     props=None) -> None:
+                     props=None, min_ops=None) -> None:
         """Columnar apply: dense (R, O) already-sequenced op planes for the
         subset of doc rows ``rows`` (R,) — the ingest hot path (no per-op
         Python objects anywhere). Ops per doc apply in column order (the
@@ -600,71 +639,186 @@ class TensorStringStore(StringOpInterner):
         the store holds intervals, compaction falls back to ``compact``
         (which re-anchors before dropping tombstones).
 
-        Docs holding intervals must use ``apply_messages`` (anchor slides
-        need per-message window tracking)."""
+        Docs holding intervals ride this path too: pass ``min_ops`` — the
+        (R, O) per-op min_seq plane the sequencer stamped — and the batch
+        is split at the exact column where a doc's window floor crosses a
+        pending tombstone (the oracle slides refs per message as the
+        window advances; sliding once per batch can pick a different
+        target). Between segments the doomed docs' anchors re-anchor off
+        the device state AT the crossing, via one fused gather for every
+        crossing doc. Without ``min_ops`` the floor is assumed not to
+        advance inside the batch (removes still feed the tombstone heaps,
+        so a later ``advance_min_seq``/``compact`` slides correctly)."""
         _t0 = time.perf_counter()
         rows = np.ascontiguousarray(rows, np.int32)
         R, O = kind.shape
         if len(np.unique(rows)) != R:
             raise ValueError("duplicate rows in columnar batch (the device "
                              "scatter would silently drop ops)")
-        if any(self._intervals[r] for r in rows):
-            raise ValueError(
-                "a targeted doc holds intervals; columnar ingest requires "
-                "the message path (anchor slides are per-message)")
         kind = np.asarray(kind, np.int32)
         ins = kind == int(OpKind.STR_INSERT)
         ann = kind == int(OpKind.STR_ANNOTATE)
         if ann.any() and props is None:
             raise ValueError("annotate slots require the props table")
-        rich = not (texts is None and props is None)
+        # interval anchors key by (payload handle, offset): two same-text
+        # inserts in one doc must NOT share a handle or the anchor becomes
+        # ambiguous (the per-message path mints one handle per op). A
+        # batch touching any interval-holding row therefore mints per-op
+        # handles and ships the resolved a2 plane; the dedup'd-table fast
+        # wire stays reserved for interval-free batches.
+        iv_handles = bool(self._iv_docs) and bool(ins.any()) \
+            and not self._iv_docs.isdisjoint(rows.tolist())
+        rich = not (texts is None and props is None) or iv_handles
+        a0 = np.asarray(a0, np.int32)
+        a1 = np.asarray(a1, np.int32)
+        rich_mode = 0          # wire form: 0 broadcast, 1 plane, 2/3 table
+        tab_a2 = tab_len = tidx_eff = None
+        tab_n = 0
         if not rich:
             # broadcast payload: a2 is one scalar handle
             a2_np = np.array([self._payload(_TEXT, text)], np.int32)
-            a1 = np.where(ins, len(text), np.asarray(a1, np.int32))
+            a1 = np.where(ins, len(text), a1)
         else:
-            a2_np = np.zeros((R, O), np.int32)
-            tidx = np.asarray(tidx, np.int32)
-            a1 = np.asarray(a1, np.int32)
-            if texts is not None:
-                handles_tab = np.fromiter(
-                    (self._payload(_TEXT, t) for t in texts), np.int32,
-                    count=len(texts))
-                lens_tab = np.fromiter(map(len, texts), np.int32,
-                                       count=len(texts))
-                a2_np[ins] = handles_tab[tidx[ins]]
-                a1 = np.where(ins, lens_tab.take(tidx, mode="clip"), a1)
-            elif ins.any():
-                h = self._payload(_TEXT, text)
-                a2_np[ins] = h
-                a1 = np.where(ins, len(text), a1)
+            if tidx is not None:
+                tidx = np.asarray(tidx, np.int32)
+            packed_tab = np.zeros((0,), np.int32)
             if props is not None and ann.any():
+                self._has_props = True
                 packed_tab = np.empty((len(props),), np.int32)
+                cache = self._props_pack_cache
                 for j, p in enumerate(props):
                     (key, value), = p.items()  # single-key by contract
-                    self._has_props = True
-                    packed_tab[j] = (self._prop_plane(key)
-                                     << PROP_HANDLE_BITS) \
-                        | self._prop_handle(value)
-                a2_np[ann] = packed_tab[tidx[ann]]
+                    try:
+                        packed = cache.get((key, value))
+                    except TypeError:   # unhashable value: intern directly
+                        packed = None
+                    if packed is None:
+                        packed = (self._prop_plane(key)
+                                  << PROP_HANDLE_BITS) \
+                            | self._prop_handle(value)
+                        try:
+                            cache[(key, value)] = packed
+                        except TypeError:
+                            pass
+                    packed_tab[j] = packed
+            if iv_handles:
+                # per-op handle mint (anchor identity), resolved a2 plane
+                rich_mode = 1
+                base_h = len(self._payloads)
+                flat_ins = np.flatnonzero(ins.reshape(-1))
+                if texts is not None:
+                    t_list = [texts[j] for j in
+                              map(int, tidx.reshape(-1)[flat_ins])]
+                else:
+                    t_list = [text] * len(flat_ins)
+                self._payloads.extend((_TEXT, t) for t in t_list)
+                a2_np = np.zeros((R, O), np.int32)
+                a2_np.reshape(-1)[flat_ins] = np.arange(
+                    base_h, base_h + len(flat_ins), dtype=np.int32)
+                lens = np.zeros((R, O), np.int32)
+                lens.reshape(-1)[flat_ins] = np.fromiter(
+                    map(len, t_list), np.int32, count=len(t_list))
+                a1 = np.where(ins, lens, a1)
+                if len(packed_tab):
+                    a2_np[ann] = packed_tab[tidx[ann]]
+                T = P = 0
+            else:
+                # ONE interner pass per unique payload/props entry: handles
+                # resolve into small per-batch TABLES (texts first, packed
+                # props after), and when the combined table fits a narrow
+                # index the wire ships u8/u16 indices + the tables instead
+                # of a resolved (R, O) i32 plane — the device gathers a2
+                # and insert lengths itself (rich-pack vectorization
+                # tentpole)
+                if texts is not None:
+                    base_h = len(self._payloads)
+                    self._payloads.extend((_TEXT, t) for t in texts)
+                    handles_tab = np.arange(base_h, base_h + len(texts),
+                                            dtype=np.int32)
+                    lens_tab = np.fromiter(map(len, texts), np.int32,
+                                           count=len(texts))
+                elif ins.any():
+                    handles_tab = np.array([self._payload(_TEXT, text)],
+                                           np.int32)
+                    lens_tab = np.array([len(text)], np.int32)
+                else:
+                    handles_tab = np.zeros((1,), np.int32)
+                    lens_tab = np.zeros((1,), np.int32)
+                T, P = len(handles_tab), len(packed_tab)
+                if T + P <= 256:
+                    rich_mode = 2
+                elif T + P <= 65536:
+                    rich_mode = 3
+                else:
+                    rich_mode = 1
+            if iv_handles:
+                pass            # a2 plane + insert lens minted above
+            elif rich_mode != 1:
+                # annotate indices shift past the text region; indices at
+                # remove/NOOP slots are never validated NOR used (the
+                # device zeroes a2 for those kinds and the gather clamps),
+                # so they ride as-is
+                tidx_eff = np.where(ann, tidx + T, tidx)
+                if texts is None and ins.any():
+                    # broadcast-insert + props form: tidx only indexes the
+                    # props table; inserts all take table entry 0
+                    tidx_eff = np.where(ins, 0, tidx_eff)
+                tab_n = max(8, 1 << (T + P - 1).bit_length())
+                tab_a2 = np.zeros((tab_n,), np.int32)
+                tab_a2[:T] = handles_tab
+                tab_a2[T:T + P] = packed_tab
+                tab_len = np.zeros((tab_n,), np.int32)
+                tab_len[:T] = lens_tab
+                # wire a1 for inserts is a placeholder (= a0, so spans stay
+                # 0 and positions stay narrow); the device substitutes the
+                # table length — the host never builds the lens plane
+                a1 = np.where(ins, a0, a1)
+            else:               # huge tables: resolved i32 a2 plane
+                a2_np = np.zeros((R, O), np.int32)
+                if texts is not None:
+                    a2_np[ins] = handles_tab[tidx[ins]]
+                    a1 = np.where(ins, lens_tab.take(tidx, mode="clip"), a1)
+                elif ins.any():
+                    a2_np[ins] = handles_tab[0]
+                    a1 = np.where(ins, lens_tab[0], a1)
+                if P:
+                    a2_np[ann] = packed_tab[tidx[ann]]
 
         # vectorized client interning. Fast path: one writer per doc row in
         # this batch (the common live-collaboration window) — R dict hits,
-        # no materialized (R·O) key array. General path: one dict hit per
-        # UNIQUE (row, client) pair via a packed int64 key (np.unique on a
-        # 1-D int key is ~10× faster than axis=0 row dedup); nacked/NOOP
-        # slots never mint an index there.
+        # no materialized (R·O) key array — with a one-entry cache: steady
+        # serving re-presents the SAME (rows, client) pairing every batch,
+        # which a memcmp detects without touching the dicts. General path:
+        # one dict hit per UNIQUE (row, client) pair via a packed int64 key
+        # (np.unique on a 1-D int key is ~10× faster than axis=0 row
+        # dedup); nacked/NOOP slots never mint an index there.
         valid = kind != int(OpKind.NOOP)
         cidx = np.zeros((R, O), np.int32)
         cid = np.asarray(client_id, np.int32)
+        cmax = 0
         if (cid == cid[:, :1]).all():
-            # mint only for rows with at least one acked op (an all-NOOP
-            # row must not consume one of the doc's MAX_CLIENTS slots —
-            # and must match what a log rebuild would intern)
-            lut = np.zeros(R, np.int32)
-            for i in np.flatnonzero(valid.any(axis=1)):
-                lut[i] = self._client(int(rows[i]), int(cid[i, 0]))
+            cid0 = np.ascontiguousarray(cid[:, 0])
+            rkey, ckey = rows.tobytes(), cid0.tobytes()
+            cached = self._cidx_cache
+            rows_any = valid.any(axis=1)
+            all_rows_valid = bool(rows_any.all())
+            if cached is not None and all_rows_valid \
+                    and cached[0] == rkey and cached[1] == ckey:
+                lut = cached[2]
+            else:
+                # mint only for rows with at least one acked op (an
+                # all-NOOP row must not consume one of the doc's
+                # MAX_CLIENTS slots — and must match what a log rebuild
+                # would intern)
+                lut = np.zeros(R, np.int32)
+                mint = self._client
+                rows_l, cid_l = rows.tolist(), cid0.tolist()
+                for i in map(int, np.flatnonzero(rows_any)):
+                    lut[i] = mint(rows_l[i], cid_l[i])
+                if all_rows_valid:
+                    self._cidx_cache = (rkey, ckey, lut)
             cidx[:] = lut[:, None]
+            cmax = int(lut.max(initial=0))
         elif valid.any():
             rr = np.broadcast_to(rows[:, None], (R, O))[valid]
             cc = cid.astype(np.int64)[valid]
@@ -674,6 +828,68 @@ class TensorStringStore(StringOpInterner):
                 [self._client(int(k >> 32), int(np.int32(k & 0xFFFFFFFF)))
                  for k in uniq], np.int32)
             cidx[valid] = lut[inv]
+            cmax = int(lut.max(initial=0))
+
+        # unsigned u16 packing would alias a (malformed) negative position
+        # to ~65535 — minima force such inputs onto the sign-preserving
+        # wide path, where they behave exactly like the per-op path
+        narrow = int(a0.max(initial=0)) < 32767 and \
+            int(a1.max(initial=0)) < 32767 and \
+            int(a0.min(initial=0)) >= 0 and int(a1.min(initial=0)) >= 0
+        seq_base = np.asarray(seq_base, np.int32)
+        seq = seq_base[:, None] + np.cumsum(valid, axis=1, dtype=np.int32)
+        lag = np.subtract(seq, np.asarray(ref_seq, np.int32))
+        np.maximum(lag, 1, out=lag)
+        ref_wide = bool((lag > 65535).any())
+        use_pallas, tile, interpret = self._pallas_choice()
+        scatter_rows = not (R == self.n_docs
+                            and np.array_equal(rows, np.arange(R)))
+        fuse = min_seq is not None and not self._iv_docs
+        ms = np.asarray(min_seq, np.int32) if fuse \
+            else np.zeros((1,), np.int32)
+        # tightest profile first: 5 B/op when spans, lags and client
+        # indexes all fit a byte (the live-collaboration common case —
+        # see _columnar_unpack_jit on why wire bytes are the ceiling).
+        # (kind-set membership via compares, not np.isin — isin costs ~8 ms
+        # at 655k ops for the same answer)
+        span = np.where(ins, a1, a1 - a0) if rich_mode < 2 \
+            else np.where(ins, 0, a1 - a0)
+        kinds_ok = bool(((kind >= 0) & ((kind <= int(OpKind.STR_ANNOTATE))
+                                        | ~valid)).all())
+        compact8 = bool(
+            narrow and not ref_wide and kinds_ok
+            and cmax < 64
+            and int(lag.max(initial=0)) < 256
+            and int(span.max(initial=0)) < 256
+            and int(span.min(initial=0)) >= 0)
+        # observability: which wire profile this batch took (head encoding,
+        # position width, payload form) — tests pin each branch by name;
+        # the rich payload's wire form (plane vs table) rides separately
+        self.last_profile = (
+            "compact8" if compact8 else
+            "ref_wide" if ref_wide else "lag16",
+            "pos16" if narrow else "pos32",
+            "rich" if rich else "broadcast")
+        self.last_rich_wire = (None if not rich else
+                               {1: "plane", 2: "tab8", 3: "tab16"}
+                               [rich_mode])
+
+        # interval crossing scan: split the batch at every column where a
+        # doc's window floor crosses a pending tombstone (mirrors the
+        # apply_messages per-message bookkeeping; mutates the heaps/floors)
+        segments = [(0, O, ())]
+        if self._iv_docs:
+            if min_ops is not None:
+                min_ops = np.asarray(min_ops)
+            splits = self._interval_scan(rows, kind, seq, min_ops)
+            if splits:
+                segs, prev = [], 0
+                for b in sorted(splits):
+                    segs.append((prev, b, splits[b]))
+                    prev = b
+                if prev < O:
+                    segs.append((prev, O, ()))
+                segments = segs
 
         # word-pack EVERYTHING into one int32 buffer: over a
         # tunnel-attached device each transfer pays the link round-trip,
@@ -691,86 +907,111 @@ class TensorStringStore(StringOpInterner):
                 b = np.concatenate([b, np.zeros(1, "<u2")])
             return b.view("<i4")
 
-        a0 = np.asarray(a0, np.int32)
-        # unsigned u16 packing would alias a (malformed) negative position
-        # to ~65535 — minima force such inputs onto the sign-preserving
-        # wide path, where they behave exactly like the per-op path
-        narrow = int(a0.max(initial=0)) < 32767 and \
-            int(a1.max(initial=0)) < 32767 and \
-            int(a0.min(initial=0)) >= 0 and int(a1.min(initial=0)) >= 0
         seg_pos = (lambda a: np.ascontiguousarray(a, "<i4").reshape(-1)) \
             if not narrow else seg_u16
-        seq_base = np.asarray(seq_base, np.int32)
-        seq = seq_base[:, None] + np.cumsum(valid, axis=1, dtype=np.int32)
-        lag = np.maximum(seq - np.asarray(ref_seq, np.int32), 1)
-        ref_wide = bool((lag > 65535).any())
-        use_pallas, tile, interpret = self._pallas_choice()
-        scatter_rows = not (R == self.n_docs
-                            and np.array_equal(rows, np.arange(R)))
-        fuse = min_seq is not None and not any(map(bool, self._intervals))
-        ms = np.asarray(min_seq, np.int32) if fuse \
-            else np.zeros((1,), np.int32)
-        # tightest profile first: 5 B/op when spans, lags and client
-        # indexes all fit a byte (the live-collaboration common case —
-        # see _columnar_unpack_jit on why wire bytes are the ceiling)
-        span = np.where(ins, a1, a1 - a0)
-        compact8 = bool(
-            narrow and not ref_wide
-            and int(lag.max(initial=0)) < 256
-            and int(span.max(initial=0)) < 256
-            and int(span.min(initial=0)) >= 0
-            and int(cidx.max(initial=0)) < 64
-            and np.isin(kind, (0, 1, 2, 12)).all())
-        # observability: which wire profile this batch took (head encoding,
-        # position width, payload form) — tests pin each branch by name
-        self.last_profile = (
-            "compact8" if compact8 else
-            "ref_wide" if ref_wide else "lag16",
-            "pos16" if narrow else "pos32",
-            "rich" if rich else "broadcast")
-        if compact8:
-            kc = np.where(kind == int(OpKind.NOOP), 3, kind) | (cidx << 2)
-            head = [seg_u8(kc), seg_u16(a0), seg_u8(span), seg_u8(lag)]
-        elif ref_wide:
-            head = [seg_u8(kind), seg_u8(cidx), seg_pos(a0), seg_pos(a1),
-                    np.ascontiguousarray(ref_seq, "<i4").reshape(-1)]
-        else:  # ship the (u16) lag; device reconstructs ref = seq - lag
-            head = [seg_u8(kind), seg_u8(cidx), seg_pos(a0), seg_pos(a1),
-                    seg_u16(lag)]
-        buf = np.concatenate(head + [
-            np.ascontiguousarray(a2_np, "<i4").reshape(-1),
-            seq_base.astype("<i4"),
-            rows.astype("<i4"),
-            ms.astype("<i4"),
-        ])
-        _t_pack = time.perf_counter()
-        planes, ms_dev = _columnar_unpack_jit(
-            jnp.asarray(buf), R=R, O=O,
-            pos_wide=not narrow, ref_wide=ref_wide, rich=rich,
-            n_docs=self.n_docs, fuse_compact=fuse,
-            scatter_rows=scatter_rows, compact8=compact8)
-        if self.mesh is not None:
-            # planes are (n_docs, O) either way: subset batches scattered
-            # by the unpack, full-store batches already in row order
-            from ..parallel.sharded import sharded_merge
-            fn = sharded_merge(self.mesh, use_pallas, tile, interpret,
-                               self._has_props, fuse)
-            self.state = fn(self.state, planes, ms_dev) if fuse \
-                else fn(self.state, planes)
-        else:
-            self.state = _columnar_merge_jit(
-                self.state, planes, ms_dev, use_pallas=use_pallas,
-                tile=tile, interpret=interpret,
-                with_props=self._has_props, fuse_compact=fuse)
+
+        def pad_cols(arr, c0, c1, wp, fill=0):
+            """Column slice padded to the wp bucket (NOOP-filled pads
+            consume no seq and touch no state)."""
+            w = c1 - c0
+            if c0 == 0 and c1 == O and wp == O:
+                return arr
+            out = np.full((R, wp), fill, np.int32)
+            out[:, :w] = arr[:, c0:c1]
+            return out
+
+        ref_i32 = None
+        if ref_wide:
+            ref_i32 = np.ascontiguousarray(ref_seq, "<i4")
+
+        pack_ms = 0.0
+        dispatch_ms = 0.0
+        _t_prep = time.perf_counter()
+        for si, (c0, c1, slides) in enumerate(segments):
+            _t_s0 = time.perf_counter()
+            last_seg = si == len(segments) - 1
+            fuse_seg = fuse and last_seg
+            ms_seg = ms if fuse_seg else np.zeros((1,), np.int32)
+            w = c1 - c0
+            # power-of-two column buckets keep the jit cache warm when a
+            # crossing splits the batch (the no-split common case keeps
+            # the exact original shape)
+            wp = O if w == O else max(8, 1 << (w - 1).bit_length())
+            k_s = pad_cols(kind, c0, c1, wp, fill=int(OpKind.NOOP))
+            a0_s = pad_cols(a0, c0, c1, wp)
+            lag_s = pad_cols(lag, c0, c1, wp, fill=1)
+            cidx_s = pad_cols(cidx, c0, c1, wp)
+            base_s = seq_base if c0 == 0 else \
+                np.ascontiguousarray(seq[:, c0 - 1])
+            if compact8:
+                span_s = pad_cols(span, c0, c1, wp)
+                kc = np.where(k_s == int(OpKind.NOOP), 3, k_s) \
+                    | (cidx_s << 2)
+                head = [seg_u8(kc), seg_u16(a0_s), seg_u8(span_s),
+                        seg_u8(lag_s)]
+            elif ref_wide:
+                head = [seg_u8(k_s), seg_u8(cidx_s), seg_pos(a0_s),
+                        seg_pos(pad_cols(a1, c0, c1, wp)),
+                        pad_cols(ref_i32, c0, c1, wp).reshape(-1)
+                        .astype("<i4", copy=False)]
+            else:  # ship the (u16) lag; device reconstructs ref=seq-lag
+                head = [seg_u8(k_s), seg_u8(cidx_s), seg_pos(a0_s),
+                        seg_pos(pad_cols(a1, c0, c1, wp)),
+                        seg_u16(lag_s)]
+            if rich_mode >= 2:
+                tail = [(seg_u8 if rich_mode == 2 else seg_u16)(
+                            pad_cols(tidx_eff, c0, c1, wp)),
+                        tab_a2.astype("<i4", copy=False),
+                        tab_len.astype("<i4", copy=False)]
+            elif rich_mode == 1:
+                tail = [np.ascontiguousarray(
+                    pad_cols(a2_np, c0, c1, wp), "<i4").reshape(-1)]
+            else:
+                tail = [a2_np.astype("<i4", copy=False)]
+            buf = np.concatenate(head + tail + [
+                base_s.astype("<i4", copy=False),
+                rows.astype("<i4", copy=False),
+                ms_seg.astype("<i4", copy=False),
+            ])
+            _t_pack = time.perf_counter()
+            planes, ms_dev = _columnar_unpack_jit(
+                jnp.asarray(buf), R=R, O=wp,
+                pos_wide=not narrow, ref_wide=ref_wide, rich=rich_mode,
+                n_docs=self.n_docs, fuse_compact=fuse_seg,
+                scatter_rows=scatter_rows, compact8=compact8,
+                tab_n=tab_n)
+            if self.mesh is not None:
+                # planes are (n_docs, O) either way: subset batches
+                # scattered by the unpack, full-store batches already in
+                # row order
+                from ..parallel.sharded import sharded_merge
+                fn = sharded_merge(self.mesh, use_pallas, tile, interpret,
+                                   self._has_props, fuse_seg)
+                self.state = fn(self.state, planes, ms_dev) if fuse_seg \
+                    else fn(self.state, planes)
+            else:
+                self.state = _columnar_merge_jit(
+                    self.state, planes, ms_dev, use_pallas=use_pallas,
+                    tile=tile, interpret=interpret,
+                    with_props=self._has_props, fuse_compact=fuse_seg)
+            _t_done = time.perf_counter()
+            pack_ms += (_t_pack - _t_s0) * 1000
+            dispatch_ms += (_t_done - _t_pack) * 1000
+            if slides:
+                # re-anchor the crossing docs off the device state AS OF
+                # this segment's end — one fused gather for all of them
+                # (the gather also drains the dispatch pipeline, so the
+                # planes it returns include this segment's ops)
+                self._slide_docs(slides)
         #: host-packing vs device-dispatch wall per columnar apply — the
         #: breakdown behind the serving throughput number (dispatches are
         #: async; device time is measured by the caller's end sync)
-        _t_done = time.perf_counter()
         self.last_apply_stats = {
-            "pack_ms": (_t_pack - _t0) * 1000,
-            "dispatch_ms": (_t_done - _t_pack) * 1000,
+            "pack_ms": (_t_prep - _t0) * 1000 + pack_ms,
+            "dispatch_ms": dispatch_ms,
+            "segments": len(segments),
         }
-        _note_dispatch("columnar", self.last_apply_stats["dispatch_ms"])
+        _note_dispatch("columnar", dispatch_ms)
         if min_seq is not None and not fuse:
             self.compact(np.asarray(min_seq))
 
@@ -851,7 +1092,7 @@ class TensorStringStore(StringOpInterner):
         else:
             self.state = compact_string_state_jit(
                 self.state, ms, with_props=self._has_props)
-        for doc in range(self.n_docs):
+        for doc in self._iv_docs:
             self._prune_tombs(doc, int(ms_host[doc]))
 
     # ----------------------------------------------------------------- reads
@@ -1052,6 +1293,7 @@ class TensorStringStore(StringOpInterner):
                 self._intervals[row][iid] = (anchor(start), anchor(end),
                                              dict(props or {}))
                 ids.append(iid)
+            self._iv_docs.add(row)
             out[row] = ids
         return out
 
@@ -1064,10 +1306,13 @@ class TensorStringStore(StringOpInterner):
         self._intervals[doc][iid] = (self._anchor_at(doc, start),
                                      self._anchor_at(doc, end),
                                      dict(props or {}))
+        self._iv_docs.add(doc)
         return iid
 
     def remove_interval(self, doc: int, iid: str) -> None:
         del self._intervals[doc][iid]
+        if not self._intervals[doc]:
+            self._iv_docs.discard(doc)
 
     def interval_endpoints(self, doc: int, iid: str):
         a, b, _props = self._intervals[doc][iid]
@@ -1091,52 +1336,128 @@ class TensorStringStore(StringOpInterner):
         if self._floor_dooms_tombstone(doc):
             self._slide_anchors_at_floor(doc)
 
+    def _interval_scan(self, rows, kind, seq, min_ops):
+        """Host-side crossing scan for a columnar batch (mirrors
+        ``apply_messages``'s per-message bookkeeping, vectorized): walk
+        each interval-holding row's op columns, advance the doc's window
+        floor from the per-op ``min_ops`` plane, and whenever the floor
+        crosses a pending tombstone record a segment boundary AFTER that
+        column (the crossing op itself lands before the slide, exactly as
+        the oracle applies the crossing message before sliding). Removes
+        feed the tombstone heap AFTER the crossing check (a remove's own
+        seq can never be ≤ the floor it ships with).
+
+        Returns {boundary_col: ((doc, floor_at_crossing), ...)}; mutates
+        the heaps and floors. With ``min_ops=None`` only the heaps are
+        fed (floor advances arrive via advance_min_seq/compact)."""
+        splits: Dict[int, list] = {}
+        rem_k = int(OpKind.STR_REMOVE)
+        noop_k = int(OpKind.NOOP)
+        iv = self._iv_docs
+        for i, d in enumerate(map(int, rows)):
+            if d not in iv:
+                continue
+            krow = kind[i]
+            rem_mask = krow == rem_k
+            if min_ops is None:
+                tombs = self._iv_tombs[d]
+                for j in map(int, np.flatnonzero(rem_mask)):
+                    heapq.heappush(tombs, int(seq[i, j]))
+                continue
+            mrow = min_ops[i]
+            floor = self._iv_min_seq[d]
+            cand = np.flatnonzero(rem_mask
+                                  | ((krow != noop_k) & (mrow > floor)))
+            if not len(cand):
+                continue
+            tombs = self._iv_tombs[d]
+            for j in map(int, cand):
+                m = int(mrow[j])
+                if m > floor:
+                    floor = m
+                    if tombs and tombs[0] <= floor:
+                        splits.setdefault(j + 1, []).append((d, floor))
+                        while tombs and tombs[0] <= floor:
+                            heapq.heappop(tombs)
+                if rem_mask[j]:
+                    heapq.heappush(tombs, int(seq[i, j]))
+            self._iv_min_seq[d] = floor
+        return {b: tuple(v) for b, v in splits.items()}
+
+    def _slide_docs(self, pairs) -> None:
+        """Re-anchor a set of (doc, floor) crossings off the CURRENT
+        device state with ONE fused gather (a per-doc plane pull pays a
+        tunnel RTT each — this is the batched device apply's slide step,
+        so it must not undo the columnar path's round-trip win)."""
+        if not pairs:
+            return
+        docs = np.asarray([d for d, _ in pairs], np.int32)
+        n = len(docs)
+        p2 = 1 << (n - 1).bit_length() if n > 1 else 1
+        rows_p = np.concatenate([docs, np.full(p2 - n, docs[0], np.int32)])
+        g = [np.asarray(x)[:n] for x in
+             _gather_rows_jit(self.state, jnp.asarray(rows_p))]
+        self.device_reads = getattr(self, "device_reads", 0) + 1
+        REGISTRY.inc("device_reads")
+        removed_g, length_g = g[2], g[4]
+        hop_g, hoff_g, count_g = g[5], g[6], g[8]
+        for j, (d, floor) in enumerate(pairs):
+            cnt = int(count_g[j])
+            self._reanchor_arrays(d, floor, removed_g[j, :cnt],
+                                  hop_g[j, :cnt], hoff_g[j, :cnt],
+                                  length_g[j, :cnt])
+
+    def _reanchor_arrays(self, doc: int, floor: int, removed, hop, hoff,
+                         length) -> None:
+        """Slide this doc's anchors off slots doomed at ``floor`` using
+        already-pulled planes: to the first following live char, else the
+        last preceding live char, else detach (oracle _slide_refs
+        rules). Locates are vectorized compares, not Python slot walks."""
+        doomed = removed <= floor
+        if not doomed.any():
+            return
+        live_idx = np.flatnonzero(removed == NOT_REMOVED)
+        hi = hoff + length
+
+        def slide(i):
+            k = np.searchsorted(live_idx, i + 1)
+            if k < len(live_idx):           # first following live char
+                j = live_idx[k]
+                return (int(hop[j]), int(hoff[j]))
+            k = np.searchsorted(live_idx, i) - 1
+            if k >= 0:                      # last preceding live char
+                j = live_idx[k]
+                return (int(hop[j]), int(hi[j]) - 1)
+            return None                     # no live text: detach
+
+        for iid, (a, b, props) in list(self._intervals[doc].items()):
+            new = []
+            for anchor in (a, b):
+                if anchor is not None:
+                    h, off = anchor
+                    hit = np.flatnonzero((hop == h) & (hoff <= off)
+                                         & (off < hi))
+                    if len(hit) and doomed[hit[0]]:
+                        anchor = slide(int(hit[0]))
+                new.append(anchor)
+            self._intervals[doc][iid] = (new[0], new[1], props)
+
     def _reanchor_for_compact(self, min_seq: np.ndarray,
                               only_doc: Optional[int] = None) -> None:
         """Before zamboni drops tombstones at or below min_seq, move anchors
-        off doomed slots: to the first following live char, else the last
-        preceding live char, else detach (oracle _slide_refs rules)."""
-        docs = range(self.n_docs) if only_doc is None else (only_doc,)
+        off doomed slots (oracle _slide_refs rules). Only docs whose
+        tombstone heap is actually doomed by the new floor pull device
+        planes — and all of them share ONE fused gather."""
+        docs = self._iv_docs if only_doc is None else (only_doc,)
+        pairs = []
         for doc in docs:
             if not self._intervals[doc]:
                 continue
-            st = self.state
-            n = int(st.count[doc])
-            removed = np.asarray(st.removed_seq[doc][:n])
-            doomed_mask = removed <= min_seq[doc]
-            if not doomed_mask.any():
-                continue
-            hop = np.asarray(st.handle_op[doc][:n])
-            hoff = np.asarray(st.handle_off[doc][:n])
-            length = np.asarray(st.length[doc][:n])
-            live = removed == NOT_REMOVED
-
-            def locate(off_h):
-                h, off = off_h
-                for i in range(n):
-                    if hop[i] == h and hoff[i] <= off < hoff[i] + length[i]:
-                        return i
-                return None
-
-            def slide(i):
-                for j in range(i + 1, n):
-                    if live[j]:
-                        return (int(hop[j]), int(hoff[j]))
-                for j in range(i - 1, -1, -1):
-                    if live[j]:
-                        return (int(hop[j]),
-                                int(hoff[j]) + int(length[j]) - 1)
-                return None
-
-            for iid, (a, b, props) in list(self._intervals[doc].items()):
-                new = []
-                for anchor in (a, b):
-                    if anchor is not None:
-                        i = locate(anchor)
-                        if i is not None and doomed_mask[i]:
-                            anchor = slide(i)
-                    new.append(anchor)
-                self._intervals[doc][iid] = (new[0], new[1], props)
+            floor = int(min_seq[doc])
+            tombs = self._iv_tombs[doc]
+            if tombs and tombs[0] <= floor:
+                pairs.append((doc, floor))
+        self._slide_docs(pairs)
 
     # ------------------------------------------------- overflow recovery
 
@@ -1158,6 +1479,7 @@ class TensorStringStore(StringOpInterner):
         planes["handle_op"] = self.remap_payload_handles(
             tmp, planes["handle_op"])
         self._client_idx[row] = dict(tmp._client_idx[src_row])
+        self._cidx_cache = None  # the row's client-index map changed
 
         prop = np.zeros((self.capacity, self.n_props), np.int32)
         if tmp._has_props:
@@ -1290,6 +1612,10 @@ class TensorStringStore(StringOpInterner):
         self._prop_planes = dict(delta["prop_planes"])
         self._prop_values.extend_from(delta["prop_values_delta"])
         self._has_props = self._has_props or delta["has_props"]
+        # the plane map was replaced wholesale and dirty rows get new
+        # client maps below — packed-props and client-lut caches are stale
+        self._props_pack_cache = {}
+        self._cidx_cache = None
         rows = np.asarray(delta["rows"], np.int32)
         if len(rows):
             for r, m in delta["client_idx"].items():
@@ -1333,9 +1659,10 @@ class TensorStringStore(StringOpInterner):
             for per_doc in delta["intervals"]]
         self._interval_counter = delta["interval_counter"]
         self._iv_min_seq = np.asarray(delta["iv_min_seq"], np.int64)
-        for d in range(self.n_docs):
-            if self._intervals[d]:
-                self._seed_tombs(d)
+        self._iv_docs = {d for d in range(self.n_docs)
+                         if self._intervals[d]}
+        for d in self._iv_docs:
+            self._seed_tombs(d)
 
     @classmethod
     def restore(cls, snap: dict, mesh=None) -> "TensorStringStore":
@@ -1376,11 +1703,15 @@ class TensorStringStore(StringOpInterner):
                                     [{} for _ in range(n_docs)])]
         store._interval_counter = snap.get("interval_counter", 0)
         store.last_profile = None
+        store.last_rich_wire = None
+        store._props_pack_cache = {}
+        store._cidx_cache = None
         store.device_reads = 0
         store._iv_min_seq = np.asarray(
             snap.get("iv_min_seq", [0] * n_docs), np.int64)
         store._iv_tombs = [[] for _ in range(n_docs)]
-        for d in range(n_docs):
-            if store._intervals[d]:
-                store._seed_tombs(d)
+        store._iv_docs = {d for d in range(n_docs)
+                          if store._intervals[d]}
+        for d in store._iv_docs:
+            store._seed_tombs(d)
         return store

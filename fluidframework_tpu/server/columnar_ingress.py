@@ -19,9 +19,15 @@ crc32):
   "acks": [[client_seq, seq], ...]} (seq < 0 = nack code).
 - type ``B``: op batch — u8 n_texts, per text (u16 len + utf-8 bytes),
   then N × 16-byte records ``row u16 | kind u8 | a0 u16 | a1 u16 |
-  tidx u8 | cseq u32 | ref u32`` (kind: 0 = insert of texts[tidx] at
-  a0, 1 = remove [a0, a1)). Annotates take the JSON front door (their
-  props tables don't width-code).
+  tidx u8 | cseq u32 | ref u32`` (kind codes:
+  ``core.protocol.ColumnarWireKind`` — 0 = insert of texts[tidx] at a0,
+  1 = remove [a0, a1)).
+- type ``R``: rich op batch — the ``B`` layout with a props table
+  between the text table and the records: u8 n_props, per prop (u16
+  len + utf-8 JSON of a SINGLE-key {key: value} dict). Adds kind 2 =
+  annotate [a0, a1) with props[tidx] — the rich-text/interval op,
+  width-coded like everything else (one small shared table per frame,
+  u8 indices per op).
 
 Windowing: ops queue per doc row; the flusher takes the HEAD op of every
 pending row (per-doc order preserved; O = 1 column per window) whenever
@@ -44,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core.protocol import ColumnarWireKind
 from ..utils import tracing
 from ..utils.telemetry import REGISTRY
 
@@ -63,15 +70,25 @@ def encode_json(obj: dict) -> bytes:
     return encode_frame(b"J", json.dumps(obj).encode())
 
 
-def encode_op_batch(texts: List[str], ops: np.ndarray) -> bytes:
-    """ops: structured array of _OP_DTYPE records."""
+def encode_op_batch(texts: List[str], ops: np.ndarray,
+                    props: Optional[List[dict]] = None) -> bytes:
+    """ops: structured array of _OP_DTYPE records. ``props`` (a table of
+    single-key dicts indexed by annotate tidx) upgrades the frame to the
+    rich ``R`` layout; without it the plain ``B`` frame is emitted."""
     parts = [bytes([len(texts)])]
     for t in texts:
         b = t.encode()
         parts.append(struct.pack("<H", len(b)))
         parts.append(b)
+    if props is not None:
+        parts.append(bytes([len(props)]))
+        for p in props:
+            b = json.dumps(p).encode()
+            parts.append(struct.pack("<H", len(b)))
+            parts.append(b)
     parts.append(np.ascontiguousarray(ops).tobytes())
-    return encode_frame(b"B", b"".join(parts))
+    return encode_frame(b"R" if props is not None else b"B",
+                        b"".join(parts))
 
 
 def read_frame(sock) -> Tuple[int, bytes]:
@@ -181,10 +198,11 @@ class _ColSession:
                 return False
             self._error(f"unknown {req.get('t')!r}")
             return False
-        if ftype == ord("B"):
+        if ftype in (ord("B"), ord("R")):
             if self.client_id is None:
                 self._error("join first")
                 return False
+            rich = ftype == ord("R")
             # validate the WHOLE frame before anything enqueues: a frame
             # rejected half-way would leave earlier ops queued and later
             # ones dropped (a silent per-doc gap)
@@ -197,20 +215,43 @@ class _ColSession:
                     off += 2
                     texts.append(payload[off:off + ln].decode())
                     off += ln
+                props: List[dict] = []
+                if rich:
+                    n_props = payload[off]
+                    off += 1
+                    for _ in range(n_props):
+                        (ln,) = struct.unpack_from("<H", payload, off)
+                        off += 2
+                        p = json.loads(payload[off:off + ln])
+                        off += ln
+                        if not isinstance(p, dict) or len(p) != 1:
+                            raise ValueError(
+                                "props entries must be single-key dicts")
+                        props.append(p)
                 if (len(payload) - off) % _OP_DTYPE.itemsize:
                     raise ValueError("record section not a whole number "
                                      "of op records")
                 ops = np.frombuffer(payload, dtype=_OP_DTYPE, offset=off)
-                ins = ops["kind"] == 0
+                top = int(ColumnarWireKind.ANNOTATE) if rich \
+                    else int(ColumnarWireKind.REMOVE)
+                if int(ops["kind"].max(initial=0)) > top:
+                    raise ValueError("op kind out of range for this "
+                                     "frame type")
+                ins = ops["kind"] == int(ColumnarWireKind.INSERT)
                 if ins.any() and (
                         n_texts == 0
                         or int(ops["tidx"][ins].max()) >= n_texts):
                     raise ValueError("tidx out of text-table range")
+                ann = ops["kind"] == int(ColumnarWireKind.ANNOTATE)
+                if ann.any() and (
+                        not props
+                        or int(ops["tidx"][ann].max()) >= len(props)):
+                    raise ValueError("tidx out of props-table range")
             except (ValueError, IndexError, struct.error,
                     UnicodeDecodeError) as e:
                 self._error(f"malformed op frame: {e}")
                 return False
-            srv._enqueue_ops(self, texts, ops)
+            srv._enqueue_ops(self, texts, ops, props)
             return True
         self._error("unknown frame type")
         return False
@@ -243,7 +284,7 @@ class ColumnarAlfred:
     # ------------------------------------------------------------ ingest side
 
     def _enqueue_ops(self, session: _ColSession, texts: List[str],
-                     ops: np.ndarray) -> None:
+                     ops: np.ndarray, props: List[dict] = ()) -> None:
         pend = self._pending
         queued = 0
         for o in ops:
@@ -257,8 +298,15 @@ class ColumnarAlfred:
                 q = pend[row] = deque()
             if not q:
                 self._pending_rows.append(row)
-            text = texts[int(o["tidx"])] if int(o["kind"]) == 0 else ""
-            q.append((session, text, int(o["kind"]), int(o["a0"]),
+            k = int(o["kind"])
+            # the queued payload is the TEXT for inserts, the single-key
+            # props DICT for annotates (frame tables don't outlive the
+            # frame; the flusher re-tables per window)
+            payload = texts[int(o["tidx"])] \
+                if k == int(ColumnarWireKind.INSERT) else \
+                props[int(o["tidx"])] \
+                if k == int(ColumnarWireKind.ANNOTATE) else ""
+            q.append((session, payload, k, int(o["a0"]),
                       int(o["a1"]), int(o["cseq"]), int(o["ref"])))
             queued += 1
         self._pending_ops += queued
@@ -287,11 +335,15 @@ class ColumnarAlfred:
         sessions: List[_ColSession] = []
         texts: List[str] = []
         text_of: Dict[str, int] = {}
+        props: List[dict] = []
+        prop_of: Dict[Tuple, int] = {}
         again: List[int] = []
+        k_ins = int(ColumnarWireKind.INSERT)
+        k_ann = int(ColumnarWireKind.ANNOTATE)
         for j in range(n):
             row = self._pending_rows.popleft()
             q = self._pending[row]
-            sess, text, k, x0, x1, cs, rf = q.popleft()
+            sess, payload, k, x0, x1, cs, rf = q.popleft()
             if q:
                 again.append(row)
             rows[j] = row
@@ -302,11 +354,20 @@ class ColumnarAlfred:
             ref[j, 0] = rf
             client[j, 0] = sess.client_id
             sessions.append(sess)
-            if k == 0:
-                h = text_of.get(text)
+            if k == k_ins:
+                h = text_of.get(payload)
                 if h is None:
-                    h = text_of[text] = len(texts)
-                    texts.append(text)
+                    h = text_of[payload] = len(texts)
+                    texts.append(payload)
+                tidx[j, 0] = h
+            elif k == k_ann:
+                (key, value), = payload.items()
+                pk = (key, value if not isinstance(value, (dict, list))
+                      else json.dumps(value, sort_keys=True))
+                h = prop_of.get(pk)
+                if h is None:
+                    h = prop_of[pk] = len(props)
+                    props.append(payload)
                 tidx[j, 0] = h
         self._pending_rows.extend(again)
         self._pending_ops -= n
@@ -314,7 +375,8 @@ class ColumnarAlfred:
                 "columnar.flush_window", every=256, ops=int(n)):
             res = self.engine.ingest_planes(
                 rows, client, cseq, ref, kind, a0, a1,
-                texts=texts or [""], tidx=tidx)
+                texts=texts or [""], tidx=tidx,
+                props=props or None)
         seqs = np.asarray(res["seq"]).reshape(-1)
         # fan the acks back, one frame per participating session
         per_sess: Dict[_ColSession, list] = {}
@@ -436,8 +498,9 @@ class ColumnarClient:
         self.rows.update(resp["rows"])
         return self.rows
 
-    def send_ops(self, texts: List[str], ops: np.ndarray) -> None:
-        self.sock.sendall(encode_op_batch(texts, ops))
+    def send_ops(self, texts: List[str], ops: np.ndarray,
+                 props: Optional[List[dict]] = None) -> None:
+        self.sock.sendall(encode_op_batch(texts, ops, props=props))
 
     def recv_json(self) -> dict:
         ftype, payload = read_frame(self.sock)

@@ -866,7 +866,13 @@ class StringServingEngine(ServingEngineBase):
         does log packing/append — host log work rides under the device
         apply, so wall time per batch is max(host, device), not the sum.
         Crash-consistency is unaffected: recovery rebuilds from summary +
-        log only, and the call returns (acks) after the log append."""
+        log only, and the call returns (acks) after the log append.
+
+        Docs holding intervals take this path too: the per-op min_seq
+        plane from the sequencer rides into ``apply_planes`` as
+        ``min_ops``, so anchor slides happen at the exact op where the
+        window floor crosses a tombstone (see docs/INTERVALS.md) — no
+        per-op submit() fallback."""
         self._check_poisoned()
         raw = getattr(self.deli, "raw", None)
         if raw is None:
@@ -883,9 +889,9 @@ class StringServingEngine(ServingEngineBase):
             raise ValueError("a targeted doc has graduated off the flat "
                              "tier; route its ops through submit()")
         kind = np.asarray(kind, np.int32)
-        allowed = [int(OpKind.STR_INSERT), int(OpKind.STR_REMOVE)]
+        top = int(OpKind.STR_REMOVE)
         if props is not None:
-            allowed.append(int(OpKind.STR_ANNOTATE))
+            top = int(OpKind.STR_ANNOTATE)
             if any(len(p) != 1 for p in props):
                 raise ValueError("columnar annotates are single-key; "
                                  "multi-key props go through submit()")
@@ -894,7 +900,11 @@ class StringServingEngine(ServingEngineBase):
             self.store.reserve_prop_tables(
                 {k for p in props for k in p},
                 [v for p in props for v in p.values()])
-        if not np.isin(kind, allowed).all():
+        # range compares, not np.isin: set membership over a 655k-op plane
+        # costs ~8 ms for the same answer (the kind codes are contiguous
+        # from STR_INSERT)
+        if not bool(((kind >= int(OpKind.STR_INSERT))
+                     & (kind <= top)).all()):
             raise ValueError("columnar planes must be dense "
                              "insert/remove" +
                              ("/annotate" if props is not None else ""))
@@ -908,13 +918,15 @@ class StringServingEngine(ServingEngineBase):
                 raise ValueError("tidx shape must match the op planes")
             if (tidx_arr < 0).any():
                 raise ValueError("negative tidx in columnar batch")
-            ins_m = kind == int(OpKind.STR_INSERT)
-            if texts is not None and ins_m.any() and \
-                    int(tidx_arr[ins_m].max()) >= len(texts):
+            # masked maxima (initial=-1) instead of boolean extraction:
+            # tidx_arr[mask] materializes a copy per check on the hot path
+            if texts is not None and int(np.max(
+                    tidx_arr, initial=-1,
+                    where=kind == int(OpKind.STR_INSERT))) >= len(texts):
                 raise ValueError("insert tidx beyond the payload table")
-            ann_m = kind == int(OpKind.STR_ANNOTATE)
-            if props is not None and ann_m.any() and \
-                    int(tidx_arr[ann_m].max()) >= len(props):
+            if props is not None and int(np.max(
+                    tidx_arr, initial=-1,
+                    where=kind == int(OpKind.STR_ANNOTATE))) >= len(props):
                 raise ValueError("annotate tidx beyond the props table")
         elif texts is not None or props is not None:
             raise ValueError("payload/props tables require the tidx plane")
@@ -944,15 +956,23 @@ class StringServingEngine(ServingEngineBase):
         # window-floor tracking for zamboni: fold this batch's MSN advance
         # in BEFORE building the fused compaction floor, so a compaction-due
         # batch zambonis at the post-batch floor (not one batch stale)
-        last_min = out_min.reshape(R, O)[:, -1]
-        for i, r in enumerate(rows):
-            self._min_seq[self._row_doc_id[r]] = int(last_min[i])
+        min_rs = out_min.reshape(R, O)
+        last_min = min_rs[:, -1]
+        # C-level dict bulk update (zip over plain-int lists), not a
+        # 10k-iteration Python loop with an int() per row
+        rdi = self._row_doc_id
+        self._min_seq.update(zip((rdi[r] for r in rows.tolist()),
+                                 last_min.tolist()))
         compact_due = self._flushes_since_compact + 1 >= self.compact_every
         ms_arr = None
         if compact_due:
             ms_arr = np.zeros((self.n_docs,), np.int32)
-            for doc_id, row in self._doc_rows.items():
-                ms_arr[row] = self._min_seq.get(doc_id, 0)
+            dr = self._doc_rows
+            if dr:
+                g = self._min_seq.get
+                ms_arr[np.fromiter(dr.values(), np.int32, count=len(dr))] \
+                    = np.fromiter((g(d, 0) for d in dr), np.int64,
+                                  count=len(dr))
         # degradation injection: an armed plan may stall the device apply
         # here (tunnel RTT spike); the watchdog below must surface it
         fault_point(SITE_APPLY_STALL, what="ingest_planes")
@@ -961,7 +981,7 @@ class StringServingEngine(ServingEngineBase):
             np.asarray(a1, np.int32), seq_base,
             np.asarray(client, np.int32),
             np.asarray(ref_seq, np.int32), text, min_seq=ms_arr,
-            texts=texts, tidx=tidx, props=props)
+            texts=texts, tidx=tidx, props=props, min_ops=min_rs)
         _t_apply = time.perf_counter()
 
         # durable log (host work, overlapped with the device apply)

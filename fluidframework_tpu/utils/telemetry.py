@@ -206,6 +206,26 @@ class Histogram:
         return self.counts[-1]
 
 
+#: fine log-spaced buckets (16 per decade vs the default 4) for the
+#: per-stage ingest timings: with quarter-decade buckets a p50 read
+#: quantizes a real 25 ms to the 31.6 ms bound — too coarse to check a
+#: ≤30 ms budget against. 0.1 ms .. ~5.6 s.
+_FINE_BOUNDS = [0.1 * (10 ** (i / 16)) for i in range(75)]
+
+#: name-prefix → bucket preset applied when ``observe`` lazily creates a
+#: histogram; first matching prefix wins
+BUCKET_PRESETS: List[tuple] = [
+    ("ingest_", _FINE_BOUNDS),
+]
+
+
+def _buckets_for(name: str) -> Optional[List[float]]:
+    for prefix, bounds in BUCKET_PRESETS:
+        if name.startswith(prefix):
+            return list(bounds)
+    return None
+
+
 class MetricsRegistry:
     """Process-wide counters, gauges, and latency histograms (SURVEY.md
     §5.5): the analog of the reference server's per-lambda Prometheus
@@ -233,7 +253,7 @@ class MetricsRegistry:
 
     def observe(self, name: str, value_ms: float) -> None:
         if name not in self.histograms:
-            self.histograms[name] = Histogram()
+            self.histograms[name] = Histogram(_buckets_for(name))
         self.histograms[name].record(value_ms)
 
     # ---------------------------------------------------------- components

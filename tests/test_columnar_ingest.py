@@ -611,18 +611,24 @@ def test_props_without_tidx_rejected_before_sequencing():
 def test_post_sequencing_failure_before_append_poisons():
     """Review r4 finding: a failure AFTER the native sequencer consumed
     seqs but BEFORE the log append (e.g. the device store refusing the
-    batch) must poison — doc.seq is ahead of the durable log."""
+    batch) must poison — doc.seq is ahead of the durable log.
+
+    (Interval-holding docs used to be the natural in-tree trigger; they
+    now ride the columnar path — see docs/INTERVALS.md — so the store
+    failure is injected directly.)"""
     R, O = 2, 4
     a, _, docs, rows = _engines(R, O)
-    # an interval on a targeted doc makes store.apply_planes raise after
-    # sequencing succeeded
     a.submit(docs[0], 1, 1, 0,
              {"mt": "insert", "kind": 0, "pos": 0, "text": "hello"})
-    a.store.add_interval(a.doc_row(docs[0]), 0, 3)
+
+    def _boom(*_a, **_k):
+        raise ValueError("device store refused the batch")
+
+    a.store.apply_planes = _boom
     kind = np.zeros((R, O), np.int32)
     z = np.zeros((R, O), np.int32)
     cseq = np.broadcast_to(np.arange(2, O + 2, dtype=np.int32), (R, O))
-    with pytest.raises(ValueError, match="intervals"):
+    with pytest.raises(ValueError, match="refused"):
         a.ingest_planes(rows, np.ones((R, O), np.int32), cseq, z,
                         kind, z, z, TEXT)
     with pytest.raises(RuntimeError, match="poisoned"):

@@ -1,0 +1,287 @@
+"""Interval-holding docs on the columnar serving fast path.
+
+Pins the serving fast-path contract for rich-text interval documents:
+
+- **Endpoint parity under seeded fuzz**: random annotate/insert/remove
+  waves go through ``ingest_planes`` (device-side batched apply with
+  slide-at-crossing) and every interval's endpoints must match the
+  pure-Python ``IntervalCollection`` oracle replayed message-by-message
+  (``apply_msg``, so the oracle zambonis at min-seq crossings exactly
+  like the reference client).
+- **Crash-restart mid-window** (chaos faultpoints): a kill between
+  sequencing and the batched apply must neither lose an anchor nor
+  mis-slide it — the recovered engine's endpoints still match the oracle
+  replay of the durable log, and keep matching for traffic sequenced
+  AFTER the restart.
+- **Routing regressions**: interval docs ride the columnar apply (the
+  old per-op fallback kept no segment accounting), every insert on an
+  interval doc mints its OWN payload handle (dedup'd table handles make
+  (handle, offset) anchor keys ambiguous), and interval-free batches
+  keep the dedup'd-table fast wire.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.interval_collection import IntervalCollection
+from fluidframework_tpu.models.merge_tree import LOCAL_VIEW
+from fluidframework_tpu.models.shared_string import SharedString
+from fluidframework_tpu.ops.schema import OpKind
+from fluidframework_tpu.server.serving import StringServingEngine
+from fluidframework_tpu.testing import chaos
+from fluidframework_tpu.utils.faultpoints import (
+    SITE_DELI_MID_WINDOW, SITE_FLUSH_MID_BATCH, CrashInjected, armed,
+)
+
+BASE_TEXT = "the quick brown fox jumps over the dazed dog"
+IV_TEXTS = ["XY"]
+IV_PROPS = [{"bold": True}, {"bold": False}]
+
+
+def _iv_engine(n_docs, seed, n_spans=3):
+    """Engine with BASE_TEXT in every doc and ``n_spans`` anchored
+    intervals per doc (bulk add). Returns (engine, docs, spans) where
+    spans[di] is [(start, end, interval_id), ...]."""
+    rng = random.Random(seed)
+    eng = StringServingEngine(n_docs=n_docs, capacity=128,
+                              batch_window=10 ** 9, compact_every=10 ** 9,
+                              sequencer="native")
+    docs = [f"iv-{i}" for i in range(n_docs)]
+    for d in docs:
+        eng.connect(d, 1)
+        _, nack = eng.submit(d, 1, 1, 0, {"mt": "insert", "kind": 0,
+                                          "pos": 0, "text": BASE_TEXT,
+                                          "clientSeq": 1})
+        assert nack is None
+    eng.flush()
+    req = {}
+    for d in docs:
+        spans = []
+        for _k in range(n_spans):
+            s = rng.randrange(len(BASE_TEXT) - 8)
+            spans.append((s, s + 2 + rng.randrange(5), None))
+        req[eng.doc_row(d)] = spans
+    ids = eng.store.add_intervals_bulk(req)
+    spans = [[(s, e, sid) for (s, e, _), sid in
+              zip(req[eng.doc_row(d)], ids[eng.doc_row(d)])]
+             for d in docs]
+    return eng, docs, spans
+
+
+def _wave(rng, n_docs, ow, w, lengths):
+    """One mixed annotate/insert/remove wave of planes; mutates
+    ``lengths`` to track per-doc text length. The ref plane is pinned at
+    the wave's first seq so the min-seq floor crosses the PREVIOUS
+    wave's tombstones mid-window (slide-at-crossing on device)."""
+    kind = np.zeros((n_docs, ow), np.int32)
+    a0 = np.zeros((n_docs, ow), np.int32)
+    a1 = np.zeros((n_docs, ow), np.int32)
+    tix = np.zeros((n_docs, ow), np.int32)
+    for di in range(n_docs):
+        ln = lengths[di]
+        for c in range(ow):
+            roll = rng.random()
+            if roll < 0.5 and ln >= 6:
+                s = rng.randrange(ln - 4)
+                kind[di, c] = OpKind.STR_ANNOTATE
+                a0[di, c], a1[di, c] = s, s + 2
+                tix[di, c] = rng.randrange(2)
+            elif roll < 0.8 or ln < 16:
+                kind[di, c] = OpKind.STR_INSERT
+                a0[di, c], a1[di, c] = rng.randrange(ln + 1), 2
+                ln += 2
+            else:
+                s = rng.randrange(ln - 3)
+                kind[di, c] = OpKind.STR_REMOVE
+                a0[di, c], a1[di, c] = s, s + 2
+                ln -= 2
+        lengths[di] = ln
+    cseq = np.broadcast_to(
+        np.arange(2 + w * ow, 2 + (w + 1) * ow, dtype=np.int32),
+        (n_docs, ow))
+    ref = np.full((n_docs, ow), 2 + w * ow, np.int32)
+    return kind, a0, a1, tix, cseq, ref
+
+
+def _oracle_endpoints(engine, doc, spans):
+    """Replay ``doc``'s durable log through the pure-Python oracle
+    (``apply_msg`` — zamboni at crossings), anchoring ``spans`` at the
+    same point in history they were added on the engine (right after the
+    base insert). Returns (text, [endpoints...])."""
+    oracle = SharedString(doc, 999)
+    msgs = engine._doc_log_messages(doc)
+    for m in (m for m in msgs if m.client_seq == 1):
+        oracle.apply_msg(m)
+    coll = IntervalCollection("c", oracle.tree)
+    for k, (s, e, _sid) in enumerate(spans):
+        coll.apply_add(f"o{k}", s, e, {}, LOCAL_VIEW, 999)
+    for m in (m for m in msgs if m.client_seq > 1):
+        oracle.apply_msg(m)
+    return (oracle.get_text(),
+            [coll.endpoints(coll.get(f"o{k}")) for k in range(len(spans))])
+
+
+@pytest.mark.parametrize("seed", [5, 23])
+def test_columnar_interval_parity_fuzz(seed):
+    """Seeded fuzz: mixed waves through the columnar ingest; every doc's
+    text AND every interval's endpoints match the oracle replay."""
+    n_docs, ow, waves = 8, 8, 5
+    rng = random.Random(seed)
+    eng, docs, spans = _iv_engine(n_docs, seed)
+    rows = np.array([eng.doc_row(d) for d in docs], np.int32)
+    client = np.ones((n_docs, ow), np.int32)
+    lengths = [len(BASE_TEXT)] * n_docs
+    seg_waves = []
+    for w in range(waves):
+        kind, a0, a1, tix, cseq, ref = _wave(rng, n_docs, ow, w, lengths)
+        res = eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1,
+                                texts=IV_TEXTS, tidx=tix, props=IV_PROPS)
+        assert res["nacked"] == 0
+        seg_waves.append(eng.store.last_apply_stats["segments"])
+    # the min-seq floor really crossed tombstones mid-window: waves past
+    # the first split into >= 2 apply segments around the slide boundary
+    assert all(s >= 2 for s in seg_waves[1:]), seg_waves
+    for di, d in enumerate(docs):
+        want_text, want_eps = _oracle_endpoints(eng, d, spans[di])
+        assert eng.read_text(d) == want_text, d
+        for k, (s, e, sid) in enumerate(spans[di]):
+            got = eng.store.interval_endpoints(eng.doc_row(d), sid)
+            assert got == want_eps[k], (d, k, got, want_eps[k])
+
+
+def test_interval_docs_take_columnar_path():
+    """Regression pin: interval docs stay ON the batched columnar apply
+    (segment accounting exists only there), and every insert mints its
+    own payload handle — the wire is the resolved a2 plane, with one
+    payload entry per insert op."""
+    n_docs, ow = 8, 8
+    rng = random.Random(7)
+    eng, docs, _spans = _iv_engine(n_docs, 7)
+    rows = np.array([eng.doc_row(d) for d in docs], np.int32)
+    client = np.ones((n_docs, ow), np.int32)
+    lengths = [len(BASE_TEXT)] * n_docs
+    n_payloads = len(eng.store._payloads)
+    kind, a0, a1, tix, cseq, ref = _wave(rng, n_docs, ow, 0, lengths)
+    res = eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1,
+                            texts=IV_TEXTS, tidx=tix, props=IV_PROPS)
+    assert res["nacked"] == 0
+    # columnar apply ran (the retired per-op fallback kept no stats)
+    assert eng.store.last_apply_stats["segments"] >= 1
+    # per-op handle mint: resolved plane wire + one payload per insert
+    assert eng.store.last_rich_wire == "plane"
+    n_inserts = int((kind == OpKind.STR_INSERT).sum())
+    assert n_inserts > 0
+    assert len(eng.store._payloads) - n_payloads == n_inserts
+
+
+def test_interval_free_batches_keep_table_wire():
+    """The per-op handle mint is interval-gated: the SAME batch on an
+    engine with no intervals still ships the dedup'd-table fast wire."""
+    n_docs, ow = 8, 8
+    rng = random.Random(7)
+    eng = StringServingEngine(n_docs=n_docs, capacity=128,
+                              batch_window=10 ** 9, compact_every=10 ** 9,
+                              sequencer="native")
+    docs = [f"nf-{i}" for i in range(n_docs)]
+    for d in docs:
+        eng.connect(d, 1)
+        eng.submit(d, 1, 1, 0, {"mt": "insert", "kind": 0, "pos": 0,
+                                "text": BASE_TEXT, "clientSeq": 1})
+    eng.flush()
+    rows = np.array([eng.doc_row(d) for d in docs], np.int32)
+    client = np.ones((n_docs, ow), np.int32)
+    lengths = [len(BASE_TEXT)] * n_docs
+    kind, a0, a1, tix, cseq, ref = _wave(rng, n_docs, ow, 0, lengths)
+    res = eng.ingest_planes(rows, client, cseq, ref, kind, a0, a1,
+                            texts=IV_TEXTS, tidx=tix, props=IV_PROPS)
+    assert res["nacked"] == 0
+    assert eng.store.last_rich_wire in ("tab8", "tab16")
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", [SITE_DELI_MID_WINDOW,
+                                  SITE_FLUSH_MID_BATCH])
+def test_crash_restart_mid_window_keeps_anchors(site):
+    """Kill the engine mid-window while interval docs take traffic;
+    recovery (summary + log-tail replay) must neither lose an anchor nor
+    mis-slide it, and anchors must KEEP sliding correctly for traffic
+    sequenced after the restart."""
+    rng = random.Random(911 + len(site))
+    docs = ["d0", "d1", "d2"]
+    clients = {d: i + 1 for i, d in enumerate(docs)}
+    victim = chaos.make_engine("string")
+    for d in docs:
+        victim.connect(d, clients[d])
+    cseq = {d: 0 for d in docs}
+    last_seq = {d: 0 for d in docs}
+
+    def push(engine, d, contents):
+        cseq[d] += 1
+        if contents.get("mt") == "insert":
+            # the oracle mints insert handles from op["clientSeq"]
+            contents["clientSeq"] = cseq[d]
+        msg, nack = engine.submit(d, clients[d], cseq[d], last_seq[d],
+                                  contents)
+        assert nack is None, nack
+        last_seq[d] = msg.seq
+        return msg
+
+    for d in docs:
+        push(victim, d, {"mt": "insert", "kind": 0, "pos": 0,
+                         "text": BASE_TEXT})
+    victim.flush()
+    spans = {}
+    for d in docs:
+        row = victim.doc_row(d)
+        ss = []
+        for _k in range(2):
+            s = rng.randrange(len(BASE_TEXT) - 8)
+            e = s + 2 + rng.randrange(5)
+            ss.append((s, e, victim.store.add_interval(row, s, e)))
+        spans[d] = ss
+    summary = victim.summarize()  # recovery anchor holds the intervals
+
+    gen = chaos.OpGen(rng, "string", docs)
+    gen._len = {d: len(BASE_TEXT) for d in docs}
+    plan = chaos.FaultPlan(crash={site: rng.randint(2, 5)})
+    with armed(plan):
+        try:
+            for i in range(24):
+                d = docs[i % len(docs)]
+                contents = gen.op(d)
+                cs_before = cseq[d]
+                push(victim, d, contents)
+        except CrashInjected:
+            cseq[d] = cs_before + 1  # the crashed op consumed its seq
+    assert plan.fired == [site], plan.hits
+
+    recovered = StringServingEngine.load(summary, victim.log)
+    for d in docs:
+        want_text, want_eps = _oracle_endpoints(recovered, d, spans[d])
+        assert recovered.read_text(d) == want_text, d
+        row = recovered.doc_row(d)
+        for k, (s, e, sid) in enumerate(spans[d]):
+            got = recovered.store.interval_endpoints(row, sid)
+            assert got == want_eps[k], (site, d, k, got, want_eps[k])
+
+    # life goes on: post-restart traffic still slides anchors in step
+    # with the oracle (resync the generator — a crashed op may have been
+    # sequenced-but-lost, so its length delta never landed)
+    for d in docs:
+        cseq[d] = max((m.client_seq
+                       for m in recovered._doc_log_messages(d)), default=0)
+        last_seq[d] = recovered.deli.doc_seq(d)
+        gen._len[d] = len(recovered.read_text(d))
+    for i in range(12):
+        d = docs[i % len(docs)]
+        push(recovered, d, gen.op(d))
+    recovered.flush()
+    for d in docs:
+        want_text, want_eps = _oracle_endpoints(recovered, d, spans[d])
+        assert recovered.read_text(d) == want_text, d
+        row = recovered.doc_row(d)
+        for k, (s, e, sid) in enumerate(spans[d]):
+            got = recovered.store.interval_endpoints(row, sid)
+            assert got == want_eps[k], (site, d, k, got, want_eps[k])

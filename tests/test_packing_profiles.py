@@ -206,7 +206,10 @@ def test_rich_payloads_ride_compact8_head():
               texts=texts, tidx=tidx, props=props)
 
 
-def test_rich_payloads_wide_span_takes_lag16():
+def test_rich_wide_payload_stays_compact8():
+    """A 300-char payload no longer widens the head: with the table wire
+    the insert's span field ships 0 (the device reads the length from the
+    table), so byte-size spans/lags keep the 5 B/op head."""
     R, O = 2, 2
     texts = ["q" * 300, "r" * 2]
     kind = np.full((R, O), INS, np.int32)
@@ -216,8 +219,26 @@ def test_rich_payloads_wide_span_takes_lag16():
     base = np.ones((R,), np.int32)
     cl = np.ones((R, O), np.int32)
     ref = np.ones((R, O), np.int32)
-    _run_both(kind, a0, a1, base, cl, ref, ("lag16", "pos16", "rich"),
-              texts=texts, tidx=tidx)
+    a = _run_both(kind, a0, a1, base, cl, ref,
+                  ("compact8", "pos16", "rich"), texts=texts, tidx=tidx)
+    assert a.last_rich_wire == "tab8"
+
+
+def test_rich_wide_remove_span_takes_lag16():
+    """A remove spanning > 255 chars on a rich batch still widens the
+    head (the span field genuinely carries it)."""
+    R, O = 2, 2
+    texts = ["q" * 300, "r" * 2]
+    kind = np.array([[INS, REM]] * R, np.int32)
+    a0 = np.zeros((R, O), np.int32)
+    a1 = np.array([[0, 280]] * R, np.int32)
+    tidx = np.array([[0, 0]] * R, np.int32)
+    base = np.ones((R,), np.int32)
+    cl = np.ones((R, O), np.int32)
+    ref = np.ones((R, O), np.int32)
+    a = _run_both(kind, a0, a1, base, cl, ref, ("lag16", "pos16", "rich"),
+                  texts=texts, tidx=tidx)
+    assert a.last_rich_wire == "tab8"
 
 
 def test_noop_slots_remap_through_compact8():

@@ -200,3 +200,61 @@ class TestDevtools:
         assert m["flush_ms_count"] >= 2 and m["flush_ms_p99_ms"] > 0
         assert view["slotUsage"]["max"] >= 1
         assert view["overflowedDocs"] == []
+
+
+# ----------------------------------------------------- bench report tool
+
+class TestBenchReport:
+    """``tools/bench_report.py`` must run clean on the checked-in driver
+    record (BENCH_r05.json: the wrapper shape whose ``tail`` is a stdout
+    STRING with the bench JSON as its last line)."""
+
+    def _mod(self):
+        import importlib.util
+        from pathlib import Path
+        path = Path(__file__).parent.parent / "tools" / "bench_report.py"
+        spec = importlib.util.spec_from_file_location("bench_report", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_regenerates_config4_from_r05(self, tmp_path):
+        import json
+        import shutil
+        from pathlib import Path
+        mod = self._mod()
+        root = Path(__file__).parent.parent
+        # work on a copy: the tool must never touch the real BENCHES.md
+        # from a test run
+        shutil.copy(root / "BENCHES.md", tmp_path / "BENCHES.md")
+        shutil.copy(root / "BENCH_r05.json", tmp_path / "BENCH_r05.json")
+        block = mod.regenerate(tmp_path, tmp_path / "BENCH_r05.json",
+                               write=True)
+        rec = json.loads(block)
+        assert rec["metric"] == "sharedstring_ops_per_sec_merged"
+        assert rec["value"] == 7283596.5
+        assert rec["serving_interval_ops_per_sec"] == 1516.7
+        assert rec["rich_pack_p50_ms"] == 100.0
+        updated = (tmp_path / "BENCHES.md").read_text()
+        assert block in updated
+        # only the Config #4 fence changed; the other sections survive
+        assert "## Config #5" in updated and "## Config #2" in updated
+        assert "config2_sharedmap_ops_per_sec" in updated
+
+    def test_latest_record_discovery_and_cli(self, tmp_path):
+        import shutil
+        import subprocess
+        import sys
+        from pathlib import Path
+        mod = self._mod()
+        root = Path(__file__).parent.parent
+        for name in ("BENCH_r01.json", "BENCH_r05.json"):
+            shutil.copy(root / name, tmp_path / name)
+        assert mod.find_latest_record(tmp_path).name == "BENCH_r05.json"
+        shutil.copy(root / "BENCHES.md", tmp_path / "BENCHES.md")
+        out = subprocess.run(
+            [sys.executable, str(root / "tools" / "bench_report.py"),
+             "--root", str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert '"sharedstring_ops_per_sec_merged"' in out.stdout

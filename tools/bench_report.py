@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Regenerate BENCHES.md's driver-recorded sections from BENCH_r*.json.
+
+The driver records every round's ``python bench.py`` run as
+``BENCH_r{NN}.json``; BENCHES.md quotes the latest record's headline
+block by hand, which drifts (stale numbers, missing new fields). This
+tool makes the quote mechanical:
+
+- finds the newest ``BENCH_r*.json`` under the repo root (or takes an
+  explicit ``--json`` path),
+- tolerates both record shapes: the bare bench JSON line, and the
+  driver wrapper ``{"n", "cmd", "rc", "tail", "parsed"}`` whose
+  ``tail`` is the run's stdout tail as a STRING (the bench JSON is its
+  last line) and whose ``parsed`` may already hold the decoded dict,
+- rewrites the fenced JSON block under the ``## Config #4`` heading
+  with a curated, stable-ordered subset of the record (all headline
+  throughputs, latency/stall accounting, and the variance bands the
+  stall-proof phases emit),
+- is a dry run by default (prints the regenerated section);
+  ``--write`` edits BENCHES.md in place.
+
+Usage::
+
+    python tools/bench_report.py                 # dry run, latest record
+    python tools/bench_report.py --write         # update BENCHES.md
+    python tools/bench_report.py --json BENCH_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+#: curated key order for the Config #4 fenced block — scalars first,
+#: then trial/band evidence; keys absent from the record are skipped so
+#: the tool stays usable on older rounds
+CONFIG4_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "docs", "total_ops",
+    "serving_ops_per_sec", "serving_ops_per_sec_median",
+    "serving_rich_ops_per_sec", "serving_rich_ops_per_sec_median",
+    "serving_durable_ops_per_sec", "serving_durable_ops_per_sec_median",
+    "serving_interval_ops_per_sec", "serving_interval_ops",
+    "serving_interval_wire",
+    "tree_serving_ops_per_sec", "tree_serving_ops_per_sec_median",
+    "tree_flat_serving_ops_per_sec",
+    "tree_kernel_ops_per_sec", "tree_kernel_trials",
+    "headline_variance_band",
+    "ack_p50_ms", "ack_p99_ms", "ack_sample_retries",
+    "serving_read_ms",
+    "apply_window_p50_ms", "apply_window_worst_ms",
+    "apply_window_retries", "apply_window_stalled",
+    "conflict_ops_per_sec", "digest_parity", "conflict_parity",
+    "contended", "backend",
+)
+
+
+def find_latest_record(root: Path) -> Path:
+    """Newest ``BENCH_r*.json`` by round number (lexicographic on the
+    zero-padded round suffix equals numeric order)."""
+    records = sorted(root.glob("BENCH_r*.json"))
+    if not records:
+        raise FileNotFoundError(f"no BENCH_r*.json under {root}")
+    return records[-1]
+
+
+def load_record(path: Path) -> dict:
+    """The bench JSON dict from either record shape (see module doc).
+    Raises ValueError on a failed run (wrapper ``rc`` != 0) or a record
+    with no parsable bench line."""
+    raw = json.loads(path.read_text())
+    if "metric" in raw:            # bare bench output
+        return raw
+    if raw.get("rc", 0) != 0:
+        raise ValueError(f"{path.name}: recorded run failed rc={raw['rc']}")
+    parsed = raw.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        return parsed
+    tail = raw.get("tail")
+    if isinstance(tail, str):
+        # the bench JSON is the tail's last non-empty line
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                rec = json.loads(line)
+                if "metric" in rec:
+                    return rec
+    raise ValueError(f"{path.name}: no bench JSON found in record")
+
+
+def config4_block(rec: dict) -> str:
+    """The curated one-line JSON for the Config #4 fenced block."""
+    out = {k: rec[k] for k in CONFIG4_KEYS if k in rec}
+    # the rich pack-stage p50 is the tentpole gate — surface it beside
+    # the throughputs when the per-stage breakdown carries it
+    stages = rec.get("ingest_stage_p50_ms")
+    if isinstance(stages, dict):
+        pack = stages.get("rich", {})
+        if isinstance(pack, dict) and "pack" in pack:
+            out["rich_pack_p50_ms"] = pack["pack"]
+        elif "rich.pack" in stages:
+            out["rich_pack_p50_ms"] = stages["rich.pack"]
+    return json.dumps(out)
+
+
+_FENCE_RE = re.compile(r"```json\n.*?\n```", re.S)
+
+
+def update_section(md: str, heading: str, block: str) -> str:
+    """Replace the first fenced JSON block after ``heading`` (up to the
+    next ``## `` heading) with ``block``. Raises ValueError when the
+    heading or its fence is missing — a silent no-op would let BENCHES.md
+    drift while looking regenerated."""
+    start = md.find(heading)
+    if start < 0:
+        raise ValueError(f"heading not found: {heading!r}")
+    end = md.find("\n## ", start + len(heading))
+    section = md[start:end] if end >= 0 else md[start:]
+    new_section, n = _FENCE_RE.subn(
+        "```json\n" + block + "\n```", section, count=1)
+    if not n:
+        raise ValueError(f"no fenced JSON block under {heading!r}")
+    return md[:start] + new_section + (md[end:] if end >= 0 else "")
+
+
+def regenerate(root: Path, json_path: Path | None = None,
+               write: bool = False) -> str:
+    """Regenerate the driver-recorded section(s) of BENCHES.md from the
+    latest (or given) record; returns the regenerated Config #4 block.
+    ``write=True`` rewrites BENCHES.md in place."""
+    record_path = json_path or find_latest_record(root)
+    rec = load_record(record_path)
+    block = config4_block(rec)
+    benches = root / "BENCHES.md"
+    md = benches.read_text()
+    updated = update_section(md, "## Config #4", block)
+    if write:
+        benches.write_text(updated)
+    return block
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", type=Path, default=Path(__file__).parent.parent,
+                    help="repo root holding BENCHES.md and BENCH_r*.json")
+    ap.add_argument("--json", type=Path, default=None,
+                    help="explicit record path (default: newest BENCH_r*)")
+    ap.add_argument("--write", action="store_true",
+                    help="rewrite BENCHES.md (default: print the block)")
+    args = ap.parse_args(argv)
+    block = regenerate(args.root, args.json, write=args.write)
+    print(block)
+    if args.write:
+        print(f"BENCHES.md updated from "
+              f"{(args.json or find_latest_record(args.root)).name}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
