@@ -42,6 +42,25 @@ def make_doc_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devices[:n]), (DOC_AXIS,))
 
 
+def doc_shard_count(mesh) -> int:
+    """Doc-axis shard count of ``mesh`` (0 when it has no docs axis) —
+    how many per-shard labeled collectors the health plane attaches."""
+    try:
+        return int(mesh.shape.get(DOC_AXIS, 0))
+    except (AttributeError, TypeError):
+        return 0
+
+
+def shard_of_rows(rows, n_docs: int, n_shards: int):
+    """Row → doc-shard index by contiguous block: the same row→device
+    placement ``NamedSharding(P(DOC_AXIS, ...))`` produces, so the
+    per-shard ``ops_applied`` rollups (ISSUE 4) credit the device that
+    actually applied the op."""
+    rows_per = max(1, n_docs // n_shards)
+    return np.minimum(np.asarray(rows, np.int64) // rows_per,
+                      n_shards - 1)
+
+
 def doc_state_specs() -> StringState:
     """PartitionSpecs of every StringState plane on a docs-only mesh."""
     row = P(DOC_AXIS, None)

@@ -247,6 +247,19 @@ class ServingEngineBase:
         # attached to the process registry for unified exposition
         self.metrics = MetricsCollector()
         REGISTRY.attach(type(self).__name__, self.metrics)
+        # health-plane mesh rollups (ISSUE 4): per-partition labeled
+        # collectors count durable-log appends per Kafka-partition analog;
+        # per-shard collectors attach lazily on the first flush/ingest
+        # (self.mesh is set by subclass __init__ AFTER this runs)
+        self.partition_metrics: List[MetricsCollector] = []
+        for p in range(self.log.n_partitions):
+            coll = MetricsCollector()
+            REGISTRY.attach(type(self).__name__, coll,
+                            labels={"partition": p})
+            self.partition_metrics.append(coll)
+        self.shard_metrics: List[MetricsCollector] = []
+        self._rows_per_shard = 1
+        self._shard_rollup_done = False
         # structured events (attach a sink via telemetry._sink or replace
         # the logger); the apply watchdog warns through it
         self.telemetry = TelemetryLogger(None, "serving")
@@ -424,6 +437,7 @@ class ServingEngineBase:
         p = self._col_part
         self._col_part = (p + 1) % self.log.n_partitions
         self.log.append(int(p), record)
+        self.partition_metrics[p].inc("appends")
         self._poisoned = None
 
     def connect(self, doc_id: str, client_id: int
@@ -515,7 +529,9 @@ class ServingEngineBase:
         clientSeq gaps) leaks capacity that was never used."""
 
     def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
-        self.log.append(partition_of(doc_id, self.log.n_partitions), msg)
+        p = partition_of(doc_id, self.log.n_partitions)
+        self.log.append(p, msg)
+        self.partition_metrics[p].inc("appends")
 
     def _enqueue(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
         self._queue.append((self.doc_row(doc_id), msg))
@@ -523,12 +539,58 @@ class ServingEngineBase:
     def _queued(self) -> int:
         return len(self._queue)
 
+    # ------------------------------------------------- per-shard rollups
+    # A meshed engine's planes are row-sharded over the docs axis; the
+    # health plane wants per-shard series (ops applied per chip, load
+    # imbalance). Rows map to shards by contiguous block — the same
+    # row→device placement NamedSharding(P("docs", ...)) uses.
+
+    def _ensure_shard_collectors(self) -> None:
+        if self._shard_rollup_done:
+            return
+        self._shard_rollup_done = True
+        mesh = getattr(self, "mesh", None)
+        if mesh is None:
+            return
+        try:
+            from ..parallel.sharded import doc_shard_count
+            n_shards = doc_shard_count(mesh)
+        except ImportError:
+            return
+        if n_shards < 2:
+            return
+        self._rows_per_shard = max(1, self.n_docs // n_shards)
+        name = type(self).__name__
+        for s in range(n_shards):
+            coll = MetricsCollector()
+            REGISTRY.attach(name, coll, labels={"shard": s})
+            self.shard_metrics.append(coll)
+
+    def _note_shard_ops(self, rows, counts=None) -> None:
+        """Credit applied ops to their row-block shards: ``rows`` is the
+        batch's row plane, ``counts`` an optional per-row op count (the
+        columnar path's valid-slot counts; default 1 per row)."""
+        if not self.shard_metrics:
+            return
+        rows = np.asarray(rows, np.int64)
+        if rows.size == 0:
+            return
+        from ..parallel.sharded import shard_of_rows
+        shard = shard_of_rows(rows, self.n_docs, len(self.shard_metrics))
+        per = np.bincount(shard, weights=counts,
+                          minlength=len(self.shard_metrics))
+        for coll, c in zip(self.shard_metrics, per):
+            if c:
+                coll.inc("ops_applied", float(c))
+
     def flush(self) -> int:
         """Template: time the subclass's device apply, record batch-size
         and latency metrics, drive the compaction cadence."""
         # crash here = the window is logged (submit acked after append)
         # but not yet applied: recovery MUST replay it from the log
         fault_point(SITE_FLUSH_MID_BATCH, queued=self._queued())
+        self._ensure_shard_collectors()
+        flushed_rows = [r for r, _ in self._queue]
         # flush parents under the newest queued op's submit span when
         # one exists (batch-triggered flush), else under the caller's
         # context (explicit flush inside a traced read)
@@ -547,7 +609,11 @@ class ServingEngineBase:
         if n:
             self.metrics.inc("flushes")
             self.metrics.inc("ops_flushed", n)
-            self.metrics.observe("flush_ms", elapsed_ms)
+            # exemplar: a later SLO breach on flush latency names the
+            # trace of the worst flush, not just the percentile
+            self.metrics.observe("flush_ms", elapsed_ms,
+                                 exemplar=sp.ctx)
+            self._note_shard_ops(flushed_rows)
         self._watch_apply(elapsed_ms, "flush", n)
         self._after_flush(n)
         return n
@@ -983,6 +1049,8 @@ class StringServingEngine(ServingEngineBase):
             np.asarray(ref_seq, np.int32), text, min_seq=ms_arr,
             texts=texts, tidx=tidx, props=props, min_ops=min_rs)
         _t_apply = time.perf_counter()
+        self._ensure_shard_collectors()
+        self._note_shard_ops(rows, counts=n_valid)
 
         # durable log (host work, overlapped with the device apply)
         ts = self.deli.clock()
@@ -1617,6 +1685,8 @@ class MapServingEngine(ServingEngineBase):
                 self.store.state, jnp.asarray(buf), R=R, O=O,
                 n_docs=self.n_docs, scatter_rows=scatter,
                 wide_vals=wide_vals)
+        self._ensure_shard_collectors()
+        self._note_shard_ops(rows, counts=n_valid)
 
         # whole-batch durable record (host work rides under the device
         # apply); nacked batches fall back to per-partition grouping is

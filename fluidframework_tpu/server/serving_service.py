@@ -51,6 +51,15 @@ class ServingLocalService(LocalService):
         self.metrics = MetricsCollector()
         REGISTRY.attach("servingService", self.metrics)
         self.telemetry = TelemetryLogger(None, "servingService")
+        # health-plane rollup (ISSUE 4): one labeled collector per deltas
+        # partition — per-partition consume lag/volume becomes its own
+        # Prometheus series instead of folding into the service blob
+        self.partition_metrics = []
+        for p in range(self.deltas_log.n_partitions):
+            coll = MetricsCollector()
+            REGISTRY.attach("servingService", coll,
+                            labels={"partition": p})
+            self.partition_metrics.append(coll)
         # channels the replica could NOT admit (store rows exhausted):
         # the ordering service still serves them — only device reads are
         # degraded — but the degradation must be VISIBLE, not silent
@@ -90,6 +99,9 @@ class ServingLocalService(LocalService):
 
     def _replica_consume(self, partition: int, offset: int,
                          msg: SequencedDocumentMessage) -> None:
+        pm = self.partition_metrics[partition]
+        pm.inc("ops_consumed")
+        pm.set_gauge("consumed_offset", offset)
         self._doc_min_seq[msg.doc_id] = max(
             self._doc_min_seq.get(msg.doc_id, 0), msg.min_seq)
         if msg.type != MessageType.OP:

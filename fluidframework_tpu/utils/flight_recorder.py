@@ -41,10 +41,15 @@ class FlightRecorder:
     """Bounded ring buffer of telemetry events + JSONL crash dumps."""
 
     def __init__(self, capacity: int = 4096, dump_dir: Optional[str] = None,
-                 max_dumps: int = 64):
+                 max_dumps: int = 64, dedup_window_s: float = 30.0):
         self.capacity = capacity
         self.enabled = True
         self.max_dumps = max_dumps
+        #: per-reason rate limit: a reason that already dumped within this
+        #: window is suppressed (counted, not written) — a chaos drill
+        #: firing the same faultpoint N times writes ONE dump + a counter
+        #: instead of spraying N near-identical files
+        self.dedup_window_s = dedup_window_s
         self._ring: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._dump_seq = 0
@@ -52,6 +57,12 @@ class FlightRecorder:
         #: paths written by :meth:`dump`, newest last (tests/operators
         #: read ``dumps[-1]`` to find the evidence file)
         self.dumps: List[str] = []
+        #: reason -> count of dumps suppressed by the rate limit
+        self.suppressed: Dict[str, int] = {}
+        # (reason, dump_dir) -> (monotonic time, path) of the last real
+        # dump; keyed on the dir too so a redirected FLUID_FLIGHT_DIR
+        # (tests, per-incident dirs) always gets its first dump
+        self._last_dump: Dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------ recording
 
@@ -81,11 +92,30 @@ class FlightRecorder:
                 or tempfile.gettempdir())
 
     def dump(self, reason: str, path: Optional[str] = None,
-             extra: Optional[dict] = None) -> str:
+             extra: Optional[dict] = None, force: bool = False) -> str:
         """Write the ring to JSONL: one header line (reason, wall time,
         event count), then one line per event, oldest first. Returns the
-        path. Default paths rotate modulo ``max_dumps`` per process."""
+        path. Default paths rotate modulo ``max_dumps`` per process.
+
+        Rate-limited per reason: a repeat of the same ``reason`` (into the
+        same dump dir) within ``dedup_window_s`` is NOT written — the
+        suppression is counted (``suppressed``, plus the process-wide
+        ``flight_dump_suppressed_total`` counter) and the FIRST dump's
+        path is returned, so callers still get evidence to point at.
+        ``force=True`` bypasses the limit (operator-initiated dumps)."""
+        dedup_key = (reason, self.dump_dir)
+        now = time.monotonic()
         with self._lock:
+            last = self._last_dump.get(dedup_key)
+            if not force and last is not None \
+                    and now - last[0] < self.dedup_window_s:
+                self.suppressed[reason] = self.suppressed.get(reason, 0) + 1
+                n = self.suppressed[reason]
+                self._ring.append({"ts": time.time(),
+                                   "eventName": "flight_dump_suppressed",
+                                   "reason": reason, "suppressed": n})
+                _count_dump(suppressed=True)
+                return last[1]
             events = list(self._ring)
             if path is None:
                 name = (f"flight-{os.getpid()}-"
@@ -105,7 +135,21 @@ class FlightRecorder:
         with self._lock:
             self.dumps.append(path)
             del self.dumps[:-self.max_dumps]
+            # recorded only after the write landed: a failed write must
+            # not arm the rate limit and suppress the retry's evidence
+            self._last_dump[dedup_key] = (now, path)
+        _count_dump(suppressed=False)
         return path
+
+
+def _count_dump(suppressed: bool) -> None:
+    """Count dumps/suppressions on the process metrics registry (late
+    import: telemetry imports this module at load time). The counter is
+    what the ``flight_dump_rate == 0`` SLO watches — a healthy steady
+    state writes zero dumps."""
+    from .telemetry import REGISTRY
+    REGISTRY.inc("flight_dump_suppressed_total" if suppressed
+                 else "flight_dump_total")
 
 
 #: the process-wide recorder (telemetry/faultpoints/chaos all feed it)

@@ -170,7 +170,17 @@ class SampledTelemetry:
 
 
 class Histogram:
-    """Fixed-bucket latency histogram with percentile reads."""
+    """Fixed-bucket latency histogram with percentile reads.
+
+    ``observe(value, exemplar=...)`` additionally captures *exemplars* —
+    (value, trace context) pairs in the Prometheus-exemplar sense — so an
+    SLO breach on a percentile can name the trace id of the worst sample
+    instead of just a number (utils.slo tags its flight dumps with it).
+    """
+
+    #: recent exemplars retained per histogram (bounded: hot paths observe
+    #: millions of samples; only the newest few are diagnostic)
+    EXEMPLAR_KEEP = 16
 
     def __init__(self, buckets_ms: Optional[List[float]] = None):
         # log-spaced defaults covering 10 µs .. 10 s
@@ -178,10 +188,35 @@ class Histogram:
             0.01 * (10 ** (i / 4)) for i in range(25)]
         self.counts = [0] * (len(self.bounds) + 1)
         self.n = 0
+        #: newest-last (value_ms, trace_id, span_id) triples
+        self.exemplars: List[tuple] = []
+        #: the exemplar with the largest value ever observed — the sample
+        #: an SLO post-mortem wants (the worst, not the latest)
+        self.worst_exemplar: Optional[tuple] = None
 
     def record(self, value_ms: float) -> None:
         self.counts[bisect.bisect_left(self.bounds, value_ms)] += 1
         self.n += 1
+
+    def observe(self, value_ms: float, exemplar: Any = None) -> None:
+        """Record a sample; ``exemplar`` may be a ``TraceContext``-like
+        object (``trace_id``/``span_id`` attrs), or ``True`` to capture
+        the thread's current trace context (no-op when none is active).
+        ``None`` (the default) records with zero exemplar overhead."""
+        self.record(value_ms)
+        if exemplar is None:
+            return
+        if exemplar is True:
+            from . import tracing  # late: tracing imports telemetry
+            exemplar = tracing.current()
+            if exemplar is None:
+                return
+        entry = (value_ms, getattr(exemplar, "trace_id", None),
+                 getattr(exemplar, "span_id", None))
+        self.exemplars.append(entry)
+        del self.exemplars[:-self.EXEMPLAR_KEEP]
+        if self.worst_exemplar is None or value_ms >= self.worst_exemplar[0]:
+            self.worst_exemplar = entry
 
     def percentile(self, p: float) -> float:
         """Upper bound of the bucket containing the p-th percentile.
@@ -238,10 +273,13 @@ class MetricsRegistry:
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, float] = {}
         self.histograms: Dict[str, Histogram] = {}
-        # name -> weakref to an attached component registry: engines come
+        # key -> weakref to an attached component registry: engines come
         # and go (tests build hundreds); the global registry must not
         # keep them alive
         self._components: Dict[str, Any] = {}
+        # key -> label dict for label-qualified attachments (shard=,
+        # replica=, partition= — the mesh rollup scheme, ISSUE 4)
+        self._component_labels: Dict[str, Dict[str, str]] = {}
 
     # ----------------------------------------------------------- recording
 
@@ -251,36 +289,62 @@ class MetricsRegistry:
     def set_gauge(self, name: str, value: float) -> None:
         self.gauges[name] = value
 
-    def observe(self, name: str, value_ms: float) -> None:
+    def observe(self, name: str, value_ms: float,
+                exemplar: Any = None) -> None:
         if name not in self.histograms:
             self.histograms[name] = Histogram(_buckets_for(name))
-        self.histograms[name].record(value_ms)
+        self.histograms[name].observe(value_ms, exemplar=exemplar)
 
     # ---------------------------------------------------------- components
 
-    def attach(self, name: str, registry: "MetricsRegistry") -> str:
-        """Register a component-local registry under ``name`` for global
-        exposition; auto-suffixes on collision (several engines of the
-        same family in one process). Returns the name used."""
+    @staticmethod
+    def component_key(name: str, labels: Optional[Dict[str, Any]]) -> str:
+        """The snapshot key for an attachment: ``name`` bare, or
+        ``name{k=v,...}`` with sorted label keys — two engines of the
+        same family with different labels can never shadow each other."""
+        if not labels:
+            return name
+        inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+        return f"{name}{{{inner}}}"
+
+    def attach(self, name: str, registry: "MetricsRegistry",
+               labels: Optional[Dict[str, Any]] = None) -> str:
+        """Register a component-local registry for global exposition.
+
+        ``labels`` qualify the key (``name{shard=0}``): the mesh rollup
+        scheme — per-shard / per-replica / per-partition collectors stay
+        distinct series in ``full_snapshot()`` and the Prometheus text.
+        Unlabeled (or same-label) collisions between *different* live
+        registries auto-suffix the name (several engines of the same
+        family in one process). Returns the key used."""
         base, i = name, 1
         while True:
-            ref = self._components.get(name)
+            key = self.component_key(name, labels)
+            ref = self._components.get(key)
             if ref is None or ref() is None or ref() is registry:
                 break
             i += 1
             name = f"{base}{i}"
-        self._components[name] = weakref.ref(registry)
-        return name
+        self._components[key] = weakref.ref(registry)
+        if labels:
+            self._component_labels[key] = {
+                k: str(v) for k, v in labels.items()}
+        return key
 
     def components(self) -> Dict[str, "MetricsRegistry"]:
         live = {}
-        for name, ref in list(self._components.items()):
+        for key, ref in list(self._components.items()):
             reg = ref()
             if reg is None:
-                del self._components[name]
+                del self._components[key]
+                self._component_labels.pop(key, None)
             else:
-                live[name] = reg
+                live[key] = reg
         return live
+
+    def component_labels(self, key: str) -> Dict[str, str]:
+        """Labels a component was attached with (empty for bare names)."""
+        return dict(self._component_labels.get(key, {}))
 
     # ------------------------------------------------------------ snapshot
 
@@ -299,22 +363,91 @@ class MetricsRegistry:
     def full_snapshot(self) -> dict:
         """Own snapshot + every live attached component's, prefixed
         ``{component}.{metric}`` — the process-wide metric set bench.py
-        embeds in BENCH json."""
+        embeds in BENCH json. Sharded attachments (components labeled
+        ``shard=``) additionally roll up into computed cross-shard skew
+        keys: ``{name}.ops_applied_shard_{min,max,skew}`` — the max/min
+        ops-applied imbalance is the load-balance health signal."""
         out = self.snapshot()
-        for name, reg in self.components().items():
+        shard_groups: Dict[str, List[float]] = {}
+        for key, reg in self.components().items():
             for k, v in reg.snapshot().items():
-                out[f"{name}.{k}"] = v
+                out[f"{key}.{k}"] = v
+            labels = self._component_labels.get(key)
+            if labels and "shard" in labels:
+                base = key.split("{", 1)[0]
+                shard_groups.setdefault(base, []).append(
+                    float(reg.counters.get("ops_applied", 0.0)))
+        for base, counts in shard_groups.items():
+            if len(counts) >= 2:
+                out[f"{base}.ops_applied_shard_min"] = min(counts)
+                out[f"{base}.ops_applied_shard_max"] = max(counts)
+                out[f"{base}.ops_applied_shard_skew"] = \
+                    max(counts) - min(counts)
         return out
+
+    def snapshot_kinds(self) -> Dict[str, str]:
+        """Kind of every key ``snapshot()`` emits: ``counter`` | ``gauge``
+        | ``quantile`` (histogram percentile reads — point-in-time, never
+        rate-derived). Histogram ``_count``/``_overflow`` keys are
+        cumulative and classified ``counter``. The time-series layer
+        (utils.timeseries) uses this to decide which series get
+        counter→rate derivation."""
+        kinds: Dict[str, str] = {}
+        for k in self.counters:
+            kinds[k] = "counter"
+        for k in self.gauges:
+            kinds[k] = "gauge"
+        for name in self.histograms:
+            kinds[f"{name}_p50_ms"] = "quantile"
+            kinds[f"{name}_p99_ms"] = "quantile"
+            kinds[f"{name}_count"] = "counter"
+            kinds[f"{name}_overflow"] = "counter"
+        return kinds
+
+    def full_snapshot_kinds(self) -> Dict[str, str]:
+        """``snapshot_kinds`` over the full (component-prefixed) key set;
+        computed skew keys are gauges."""
+        kinds = self.snapshot_kinds()
+        for key, reg in self.components().items():
+            for k, kind in reg.snapshot_kinds().items():
+                kinds[f"{key}.{k}"] = kind
+            labels = self._component_labels.get(key)
+            if labels and "shard" in labels:
+                base = key.split("{", 1)[0]
+                for suffix in ("min", "max", "skew"):
+                    kinds[f"{base}.ops_applied_shard_{suffix}"] = "gauge"
+        return kinds
+
+    def find_histogram(self, snapshot_key: str) -> Optional[Histogram]:
+        """The Histogram behind a full-snapshot key (e.g.
+        ``StringServingEngine.flush_ms_p99_ms`` → that engine's
+        ``flush_ms`` histogram), or None — the SLO engine resolves breach
+        exemplars through this."""
+        comp, _, metric = snapshot_key.rpartition(".")
+        reg = self if not comp else self.components().get(comp)
+        if reg is None:
+            return None
+        for suffix in ("_p50_ms", "_p99_ms", "_count", "_overflow"):
+            if metric.endswith(suffix):
+                metric = metric[:-len(suffix)]
+                break
+        return reg.histograms.get(metric)
 
     def render_prometheus(self, include_components: bool = True) -> str:
         """Prometheus text exposition (counters/gauges as single samples,
         histograms as ``_bucket``/``_sum``-less cumulative bucket lines —
-        bounds are upper edges in ms, ``+Inf`` is the overflow bucket)."""
+        bounds are upper edges in ms, ``+Inf`` is the overflow bucket).
+        Labeled attachments carry their labels on every sample
+        (``component="StringServingEngine",shard="3"``) — the per-shard /
+        per-replica / per-partition series of the mesh rollup scheme."""
         lines: List[str] = []
 
-        def emit(prefix: str, reg: "MetricsRegistry") -> None:
-            lab = f'{{component="{prefix}"}}' if prefix else ""
-            comp = f'component="{prefix}",' if prefix else ""
+        def emit(prefix: str, reg: "MetricsRegistry",
+                 labels: Optional[Dict[str, str]] = None) -> None:
+            pairs = ([f'component="{prefix}"'] if prefix else []) + \
+                [f'{k}="{v}"' for k, v in sorted((labels or {}).items())]
+            lab = "{" + ",".join(pairs) + "}" if pairs else ""
+            comp = ",".join(pairs) + "," if pairs else ""
             for k in sorted(reg.counters):
                 lines.append(f"# TYPE {_prom_name(k)} counter")
                 lines.append(f"{_prom_name(k)}{lab} {reg.counters[k]}")
@@ -335,8 +468,9 @@ class MetricsRegistry:
 
         emit("", self)
         if include_components:
-            for cname, reg in sorted(self.components().items()):
-                emit(cname, reg)
+            for key, reg in sorted(self.components().items()):
+                emit(key.split("{", 1)[0], reg,
+                     self._component_labels.get(key))
         return "\n".join(lines) + "\n"
 
 

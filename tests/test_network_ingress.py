@@ -178,10 +178,19 @@ def test_late_reader_sees_converged_text(server):
     text.insert_text(0, "hello ")
     text.insert_text(6, "world")
     fc.flush()
-    fc.pump_until(lambda: text.get_text() == "hello world", timeout=15)
+    # local edits apply optimistically, so the text predicate alone can be
+    # true while an op is still in flight; disposing then loses it (a
+    # dirty close drops unacked ops by contract). Wait for the acks too.
+    fc.pump_until(lambda: text.get_text() == "hello world"
+                  and not fc.container.runtime.pending.has_pending,
+                  timeout=15)
     fc.dispose()
 
     reader = NetworkClient(port=server.port, enable_summarizer=False)
     fc2 = reader.get_container(doc_id, SCHEMA)
+    # catch-up is delivered over the wire: pump until the tail replay
+    # lands rather than asserting an instantaneous load
+    fc2.pump_until(lambda: fc2.initial_objects["text"].get_text()
+                   == "hello world", timeout=15)
     assert fc2.initial_objects["text"].get_text() == "hello world"
     fc2.dispose()

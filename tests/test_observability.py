@@ -347,3 +347,56 @@ def test_every_send_warning_site_also_counts():
     assert not offenders, (
         "send_warning without a metrics counter in the same function "
         f"(warn-only degradation): {offenders}")
+
+
+def _metric_names_in_tree():
+    """AST sweep of every ``.inc(`` / ``.set_gauge(`` / ``.observe(``
+    call whose first argument names a metric: string literals verbatim,
+    f-strings as their literal prefix + ``*`` (the per-reason counter
+    families), and both arms of a literal conditional. ``observe`` calls
+    with a non-string first arg are ``Histogram.observe(value)`` — not a
+    name site. Returns {name: "file:line"}."""
+    roots = [PKG_ROOT,
+             PKG_ROOT.parent / "bench.py",
+             PKG_ROOT.parent / "tools"]
+    files = []
+    for r in roots:
+        files += sorted(r.rglob("*.py")) if r.is_dir() else [r]
+    kinds = {"inc", "set_gauge", "observe"}
+    names = {}
+
+    def literal_names(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, ast.JoinedStr) and node.values and \
+                isinstance(node.values[0], ast.Constant):
+            return [str(node.values[0].value) + "*"]
+        if isinstance(node, ast.IfExp):
+            return literal_names(node.body) + literal_names(node.orelse)
+        return []
+
+    for path in files:
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in kinds and node.args):
+                continue
+            for name in literal_names(node.args[0]):
+                names.setdefault(name, f"{path.name}:{node.lineno}")
+    return names
+
+
+def test_metric_names_all_in_observability_doc():
+    """Dark-metric lint (ISSUE 4 satellite): every metric name used in
+    the tree must appear, backtick-quoted, in docs/OBSERVABILITY.md's
+    registry — a counter nobody documented is a counter nobody reads."""
+    doc = (PKG_ROOT.parent / "docs" / "OBSERVABILITY.md").read_text()
+    names = _metric_names_in_tree()
+    assert names, "AST sweep found no metric call sites — lint is broken"
+    assert len(names) > 20, f"sweep saw too few sites: {sorted(names)}"
+    missing = [f"{n} ({where})" for n, where in sorted(names.items())
+               if f"`{n}`" not in doc]
+    assert not missing, (
+        "metric names missing from docs/OBSERVABILITY.md's registry "
+        f"table: {missing}")

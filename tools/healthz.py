@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Text health dashboard over a TimeSeriesStore JSONL export.
+
+The operator-facing face of the health plane (ISSUE 4): bench.py (and
+any serving loop ticking a ``TimeSeriesStore`` with ``jsonl_path=``)
+leaves a JSONL trail of metric samples; this tool re-loads it and
+renders the two things an operator checks first:
+
+- ``render_sparklines()`` — one line per active metric, recent shape +
+  latest value + derived rate for counters;
+- the SLO scorecard — every standing objective (``utils.slo.
+  default_slos()`` plus any ``--slo "metric < threshold"`` extras)
+  judged over the export's history with fast/slow burn windows.
+
+Usage::
+
+    python tools/healthz.py health.jsonl              # dashboard + SLOs
+    python tools/healthz.py health.jsonl --names '*shard*'
+    python tools/healthz.py --demo                    # synthetic sample
+    python tools/healthz.py h.jsonl --slo "ops_ingested_rate > 100"
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+from fluidframework_tpu.utils import slo as slo_mod          # noqa: E402
+from fluidframework_tpu.utils import telemetry, timeseries   # noqa: E402
+
+
+def _demo_store() -> timeseries.TimeSeriesStore:
+    """A synthetic ramp so the dashboard can be seen without a bench
+    run: a counter ramping up, a latency gauge breaching its SLO."""
+    reg = telemetry.MetricsRegistry()
+    store = timeseries.TimeSeriesStore(registry=reg)
+    for i in range(32):
+        reg.inc("ops_ingested", 100 + 10 * i)
+        reg.set_gauge("ack_p99_ms", 40 + (0 if i < 24 else 60 * (i - 23)))
+        reg.set_gauge("digest_parity", 1.0)
+        store.tick(now=float(i))
+    return store
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", nargs="?", help="TimeSeriesStore export")
+    ap.add_argument("--demo", action="store_true",
+                    help="render a synthetic store instead of a file")
+    ap.add_argument("--names", default=None,
+                    help="fnmatch filter on metric names")
+    ap.add_argument("--width", type=int, default=24)
+    ap.add_argument("--all", action="store_true",
+                    help="include all-zero flat series")
+    ap.add_argument("--slo", action="append", default=[],
+                    metavar="SPEC",
+                    help='extra SLO, e.g. "ack_p99_ms < 200" (repeatable)')
+    ap.add_argument("--no-slo", action="store_true",
+                    help="skip the SLO scorecard")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        store = _demo_store()
+    elif args.jsonl:
+        store = timeseries.TimeSeriesStore.from_jsonl(args.jsonl)
+    else:
+        ap.error("either a JSONL path or --demo is required")
+    names = None
+    if args.names:
+        names = [n for n in store.names()
+                 if fnmatch.fnmatchcase(n, args.names)]
+    print(store.render_sparklines(names=names, width=args.width,
+                                  active_only=not args.all), end="")
+    if args.no_slo:
+        return 0
+    specs = slo_mod.default_slos() + [slo_mod.SLOSpec.parse(s)
+                                      for s in args.slo]
+    engine = slo_mod.SLOEngine(store, specs=specs,
+                               registry=store.registry)
+    rows = engine.scorecard()
+    print()
+    print(slo_mod.render_scorecard(rows), end="")
+    # the dashboard reports; only an explicitly breaching scorecard row
+    # fails the invocation (operators pipe this into CI gates)
+    return 1 if any(not r["ok"] for r in rows) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

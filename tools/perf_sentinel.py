@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel over the BENCH_r*.json trajectory.
+
+The driver records every round's ``python bench.py`` run as
+``BENCH_r{NN}.json``; nothing so far READS the whole trajectory — a
+regression between rounds is only caught if a human happens to diff two
+records. This tool is the mechanical judge (ISSUE 4 tentpole piece 4):
+
+- load every committed round (oldest → newest, via ``bench_report``'s
+  shape-tolerant ``load_record``),
+- for each scalar metric in the NEWEST round, compare against the median
+  of the prior rounds, with a variance band wide enough for the known
+  tunnel noise: ``band = max(rel_band·|median|, k_sigma·stdev(priors))``
+  (defaults 10% / 3σ — the committed r01–r05 swings, including the −12%
+  conflict-throughput dip, sit inside it; a real cliff does not),
+- emit one verdict per metric: ``regress`` / ``improve`` / ``flat``
+  (plus ``new`` for metrics without enough history and ``info`` for
+  metrics that must never fail the build — worst-case single samples,
+  environmental RTT, config constants),
+- exit nonzero iff any metric regressed beyond its band.
+
+Direction is inferred from the name (``*ops_per_sec*`` up is good,
+``*_ms``/``*_retries`` down is good); parity booleans are must-hold.
+``--write-md`` refreshes the ``## Trajectory`` section in BENCHES.md;
+``--check`` is the quiet tier-1 mode (table only on failure). bench.py
+imports :func:`judge` to embed a live verdict in its own record.
+
+Usage::
+
+    python tools/perf_sentinel.py              # verdict table, exit 0/1
+    python tools/perf_sentinel.py --check      # tier-1 gate
+    python tools/perf_sentinel.py --write-md   # refresh BENCHES.md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import bench_report  # noqa: E402  (tools/ is not a package)
+
+#: verdicts that can fail the build
+REGRESS = "regress"
+IMPROVE = "improve"
+FLAT = "flat"
+NEW = "new"       # not enough prior rounds to judge
+INFO = "info"     # tracked but never failing
+
+#: metrics where a LOWER newest value is the bad direction
+HIGHER_BETTER_HINTS = ("ops_per_sec", "per_sec")
+HIGHER_BETTER_EXACT = {"value", "vs_baseline"}
+#: metrics where a HIGHER newest value is the bad direction
+LOWER_BETTER_SUFFIXES = ("_ms", "_retries", "_round_trips", "_stalled")
+#: booleans that must stay truthy once they have held for >=1 prior round
+MUST_HOLD = {"digest_parity", "conflict_parity"}
+#: never-failing metrics: worst-case single samples are outliers by
+#: construction (the committed r05 carries a known 983 ms stall), RTT is
+#: the tunnel's property not the code's, and config constants are inputs
+INFO_PATTERNS = ("worst",)
+INFO_EXACT = {"dispatch_rtt_ms", "docs", "total_ops", "contended"}
+
+
+def classify(name: str) -> Optional[str]:
+    """'up' (higher better), 'down' (lower better), 'info', 'hold'
+    (boolean must-hold), or None for unjudgeable names."""
+    if name in MUST_HOLD:
+        return "hold"
+    if name in INFO_EXACT or any(p in name for p in INFO_PATTERNS):
+        return "info"
+    if name in HIGHER_BETTER_EXACT or \
+            any(h in name for h in HIGHER_BETTER_HINTS):
+        return "up"
+    if name.endswith(LOWER_BETTER_SUFFIXES):
+        return "down"
+    return "info"
+
+
+def load_trajectory(root: Path) -> List[dict]:
+    """Every committed round's parsed bench record, oldest → newest.
+    Rounds that fail to parse are skipped with a stderr note (one torn
+    record must not blind the sentinel to the rest)."""
+    rounds: List[dict] = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        try:
+            rec = bench_report.load_record(path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"perf_sentinel: skipping {path.name}: {e}",
+                  file=sys.stderr)
+            continue
+        rec["_round"] = path.stem
+        rounds.append(rec)
+    return rounds
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def _stdev(vals: List[float]) -> float:
+    if len(vals) < 2:
+        return 0.0
+    mean = sum(vals) / len(vals)
+    return math.sqrt(sum((v - mean) ** 2 for v in vals)
+                     / (len(vals) - 1))
+
+
+def judge(rounds: List[dict], rel_band: float = 0.10,
+          k_sigma: float = 3.0, min_priors: int = 2) -> List[dict]:
+    """Verdict per scalar metric of the newest round vs its history.
+
+    A metric regresses when its newest value falls outside
+    ``max(rel_band·|median|, k_sigma·stdev)`` of the prior rounds in the
+    bad direction for its class; the same excursion in the good
+    direction is ``improve``. Metrics seen in fewer than ``min_priors``
+    prior rounds are ``new`` — a metric's first appearance can never
+    fail the build."""
+    if not rounds:
+        return []
+    newest, priors = rounds[-1], rounds[:-1]
+    verdicts: List[dict] = []
+    for name in sorted(newest):
+        if name.startswith("_"):
+            continue
+        val = newest[name]
+        direction = classify(name)
+        if isinstance(val, bool):
+            if direction != "hold":
+                continue
+            held = [r[name] for r in priors if isinstance(r.get(name), bool)]
+            ok = val or not any(held)
+            verdicts.append({
+                "metric": name, "verdict": FLAT if ok else REGRESS,
+                "value": val, "expected": "true (must hold)",
+                "delta_pct": None,
+                "note": "held" if ok else "parity lost vs prior rounds",
+            })
+            continue
+        if not isinstance(val, (int, float)):
+            continue
+        hist = [float(r[name]) for r in priors
+                if isinstance(r.get(name), (int, float))
+                and not isinstance(r.get(name), bool)]
+        if len(hist) < min_priors:
+            verdicts.append({"metric": name, "verdict": NEW,
+                             "value": val, "expected": None,
+                             "delta_pct": None,
+                             "note": f"{len(hist)} prior round(s)"})
+            continue
+        med = _median(hist)
+        band = max(rel_band * abs(med), k_sigma * _stdev(hist))
+        delta = float(val) - med
+        delta_pct = (delta / med * 100.0) if med else None
+        if abs(delta) <= band:
+            verdict = FLAT
+        elif direction == "info":
+            verdict = INFO
+        elif direction == "up":
+            verdict = IMPROVE if delta > 0 else REGRESS
+        elif direction == "down":
+            verdict = IMPROVE if delta < 0 else REGRESS
+        else:
+            verdict = INFO
+        verdicts.append({
+            "metric": name, "verdict": verdict, "value": val,
+            "expected": f"{med:g} ±{band:g}",
+            "delta_pct": None if delta_pct is None
+            else round(delta_pct, 2),
+            "note": f"n={len(hist)}",
+        })
+    return verdicts
+
+
+def has_regression(verdicts: List[dict]) -> bool:
+    return any(v["verdict"] == REGRESS for v in verdicts)
+
+
+def render_table(verdicts: List[dict], rounds: List[dict]) -> str:
+    """Fixed-width verdict table, regressions first."""
+    order = {REGRESS: 0, IMPROVE: 1, NEW: 2, INFO: 3, FLAT: 4}
+    rows = sorted(verdicts, key=lambda v: (order[v["verdict"]],
+                                           v["metric"]))
+    newest = rounds[-1]["_round"] if rounds else "?"
+    head = (f"perf sentinel: {newest} vs {len(rounds) - 1} prior "
+            f"round(s)")
+    out = [head, "=" * len(head),
+           f"{'METRIC':<36s} {'VERDICT':<8s} {'VALUE':>14s} "
+           f"{'Δ%':>8s}  EXPECTED"]
+    for v in rows:
+        val = v["value"]
+        val_s = f"{val:g}" if isinstance(val, float) else str(val)
+        d = v["delta_pct"]
+        out.append(
+            f"{v['metric']:<36s} {v['verdict']:<8s} {val_s:>14s} "
+            f"{'' if d is None else format(d, '+.1f'):>8s}  "
+            f"{v['expected'] or v['note']}")
+    counts: Dict[str, int] = {}
+    for v in verdicts:
+        counts[v["verdict"]] = counts.get(v["verdict"], 0) + 1
+    out.append("-- " + "  ".join(f"{k}:{counts[k]}"
+                                 for k in sorted(counts)))
+    return "\n".join(out) + "\n"
+
+
+# --------------------------------------------------------- BENCHES.md
+
+TRAJECTORY_HEADING = "## Trajectory"
+
+
+def trajectory_block(rounds: List[dict], verdicts: List[dict]) -> str:
+    """One-line JSON per round (headline metrics only) + the newest
+    round's non-flat verdicts — the fenced block under ## Trajectory."""
+    lines = []
+    for r in rounds:
+        lines.append(json.dumps({
+            "round": r["_round"],
+            **{k: r[k] for k in ("value", "serving_ops_per_sec",
+                                 "ack_p99_ms", "digest_parity")
+               if k in r}}))
+    notable = [v for v in verdicts if v["verdict"] not in (FLAT, NEW)]
+    lines.append(json.dumps({
+        "sentinel": {"regressions": [v["metric"] for v in notable
+                                     if v["verdict"] == REGRESS],
+                     "improvements": [v["metric"] for v in notable
+                                      if v["verdict"] == IMPROVE]}}))
+    return "\n".join(lines)
+
+
+def write_md(root: Path, rounds: List[dict],
+             verdicts: List[dict]) -> None:
+    benches = root / "BENCHES.md"
+    md = benches.read_text()
+    if TRAJECTORY_HEADING not in md:
+        md = md.rstrip("\n") + (
+            f"\n\n{TRAJECTORY_HEADING} — sentinel view of all rounds"
+            "\n\nRegenerated by `python tools/perf_sentinel.py "
+            "--write-md`; one line per round, newest verdicts last.\n\n"
+            "```json\n{}\n```\n")
+    md = bench_report.update_section(
+        md, TRAJECTORY_HEADING, trajectory_block(rounds, verdicts))
+    benches.write_text(md)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", type=Path,
+                    default=Path(__file__).parent.parent)
+    ap.add_argument("--rel-band", type=float, default=0.10,
+                    help="relative band around the prior median")
+    ap.add_argument("--k-sigma", type=float, default=3.0,
+                    help="stdev multiplier for the variance band")
+    ap.add_argument("--check", action="store_true",
+                    help="quiet tier-1 mode: table only on regression")
+    ap.add_argument("--write-md", action="store_true",
+                    help="refresh the ## Trajectory section in BENCHES.md")
+    ap.add_argument("--json", action="store_true",
+                    help="print verdicts as JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    rounds = load_trajectory(args.root)
+    if len(rounds) < 2:
+        print("perf_sentinel: fewer than 2 readable rounds; nothing to "
+              "judge", file=sys.stderr)
+        return 0
+    verdicts = judge(rounds, rel_band=args.rel_band,
+                     k_sigma=args.k_sigma)
+    failed = has_regression(verdicts)
+    if args.json:
+        print(json.dumps(verdicts, indent=2))
+    elif not args.check or failed:
+        print(render_table(verdicts, rounds), end="")
+    if args.write_md:
+        write_md(args.root, rounds, verdicts)
+        print(f"BENCHES.md {TRAJECTORY_HEADING!r} refreshed",
+              file=sys.stderr)
+    if args.check and not failed:
+        print(f"perf_sentinel: OK — {len(verdicts)} metrics within band "
+              f"across {len(rounds)} rounds")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
