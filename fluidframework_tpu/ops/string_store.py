@@ -308,6 +308,33 @@ def _columnar_merge_jit(state, planes, min_seq, use_pallas, tile,
     return out
 
 
+class PrepackedPlanes:
+    """The seq-independent half of a columnar apply's host pack: payload/
+    props tables interned, wire form chosen, insert lengths resolved —
+    everything ``apply_planes`` needs that does NOT depend on sequencing
+    results. Produced by ``TensorStringStore.prepack_planes`` (the
+    pipelined-ingest pack worker runs it concurrent with the previous
+    wave's dispatch) and consumed exactly once, in submission order —
+    payload-handle allocation happens at prepack time, so waves must be
+    prepacked and applied FIFO or handle numbering diverges from a
+    serial execution."""
+
+    __slots__ = ("rich", "rich_mode", "a2_np", "tab_a2", "tab_len",
+                 "tab_n", "tidx_eff", "a1", "prep_ms", "pooled")
+
+    def __init__(self):
+        self.rich = False
+        self.rich_mode = 0
+        self.a2_np = None
+        self.tab_a2 = None
+        self.tab_len = None
+        self.tab_n = 0
+        self.tidx_eff = None
+        self.a1 = None
+        self.prep_ms = 0.0
+        self.pooled = False
+
+
 class StringOpInterner:
     """Shared host-side message→op-record translation for the flat and
     mega-doc stores: payload/client/property interning and the
@@ -339,6 +366,12 @@ class StringOpInterner:
         # batch: steady serving re-interns the same (row, client) pairs
         # every batch — a 40 KB memcmp replaces R dict hits
         self._cidx_cache: Optional[tuple] = None
+        # pow2 payload-table buffer pool, keyed by tab_n: steady rich
+        # serving re-packs same-capacity tables every wave; reusing the
+        # buffers (zero only the stale tail) drops an alloc+full-zero per
+        # wave. list ops are GIL-atomic, so a pipelined pack worker can
+        # pop while the dispatch stage returns (see _tab_buffers).
+        self._tab_pool: Dict[int, list] = {}
 
     def _client(self, doc: int, client_id: int) -> int:
         m = self._client_idx[doc]
@@ -610,9 +643,199 @@ class TensorStringStore(StringOpInterner):
             jnp.asarray(planes[k]) for k in
             ("kind", "a0", "a1", "a2", "seq", "client", "ref_seq")))
 
+    def _tab_buffers(self, tab_n: int, T: int, P: int):
+        """A (tab_a2, tab_len) pair of ``tab_n`` int32 buffers — reused
+        from the pow2 pool when available (only the region past the live
+        entries is re-zeroed; callers overwrite ``[:T+P]`` / ``[:T]``)."""
+        pool = self._tab_pool.get(tab_n)
+        if pool:
+            tab_a2, tab_len = pool.pop()
+            tab_a2[T + P:] = 0
+            tab_len[T:] = 0
+            return tab_a2, tab_len, True
+        return (np.zeros((tab_n,), np.int32),
+                np.zeros((tab_n,), np.int32), True)
+
+    def _tab_release(self, pp: PrepackedPlanes) -> None:
+        """Return a prepack's table buffers to the pow2 pool once the
+        wire buffer has been built (np.concatenate copied them)."""
+        if pp.pooled and pp.tab_a2 is not None:
+            pool = self._tab_pool.setdefault(pp.tab_n, [])
+            if len(pool) < 4:   # depth-bounded pipeline: tiny pool suffices
+                pool.append((pp.tab_a2, pp.tab_len))
+        pp.tab_a2 = pp.tab_len = None
+
+    def _pack_payload_tables(self, rows, kind, a0, a1, text, texts, tidx,
+                             props) -> PrepackedPlanes:
+        """Build the payload/props side of a columnar apply's wire form:
+        intern payloads, pack props, choose the rich wire mode, resolve
+        insert lengths. Depends only on the RAW op planes — never on
+        sequencing results — so the pipelined executor runs it on a pack
+        worker concurrent with the previous wave's dispatch. Mutates the
+        interner (payload handles allocate here): call in submission
+        order, consume each result exactly once."""
+        _t0 = time.perf_counter()
+        pp = PrepackedPlanes()
+        R, O = kind.shape
+        ins = kind == int(OpKind.STR_INSERT)
+        ann = kind == int(OpKind.STR_ANNOTATE)
+        if ann.any() and props is None:
+            raise ValueError("annotate slots require the props table")
+        # interval anchors key by (payload handle, offset): two same-text
+        # inserts in one doc must NOT share a handle or the anchor becomes
+        # ambiguous (the per-message path mints one handle per op). A
+        # batch touching any interval-holding row therefore mints per-op
+        # handles and ships the resolved a2 plane; the dedup'd-table fast
+        # wire stays reserved for interval-free batches.
+        iv_handles = bool(self._iv_docs) and bool(ins.any()) \
+            and not self._iv_docs.isdisjoint(
+                np.asarray(rows).reshape(-1).tolist())
+        pp.rich = not (texts is None and props is None) or iv_handles
+        if not pp.rich:
+            # broadcast payload: a2 is one scalar handle
+            pp.a2_np = np.array([self._payload(_TEXT, text)], np.int32)
+            pp.a1 = np.where(ins, len(text), a1)
+            pp.prep_ms = (time.perf_counter() - _t0) * 1000
+            return pp
+        if tidx is not None:
+            tidx = np.asarray(tidx, np.int32)
+        packed_tab = np.zeros((0,), np.int32)
+        if props is not None and ann.any():
+            self._has_props = True
+            packed_tab = np.empty((len(props),), np.int32)
+            cache = self._props_pack_cache
+            for j, p in enumerate(props):
+                (key, value), = p.items()  # single-key by contract
+                try:
+                    packed = cache.get((key, value))
+                except TypeError:   # unhashable value: intern directly
+                    packed = None
+                if packed is None:
+                    packed = (self._prop_plane(key)
+                              << PROP_HANDLE_BITS) \
+                        | self._prop_handle(value)
+                    try:
+                        cache[(key, value)] = packed
+                    except TypeError:
+                        pass
+                packed_tab[j] = packed
+        if iv_handles:
+            # per-op handle mint (anchor identity), resolved a2 plane
+            pp.rich_mode = 1
+            base_h = len(self._payloads)
+            flat_ins = np.flatnonzero(ins.reshape(-1))
+            if texts is not None:
+                t_list = [texts[j] for j in
+                          map(int, tidx.reshape(-1)[flat_ins])]
+            else:
+                t_list = [text] * len(flat_ins)
+            self._payloads.extend((_TEXT, t) for t in t_list)
+            a2_np = np.zeros((R, O), np.int32)
+            a2_np.reshape(-1)[flat_ins] = np.arange(
+                base_h, base_h + len(flat_ins), dtype=np.int32)
+            lens = np.zeros((R, O), np.int32)
+            lens.reshape(-1)[flat_ins] = np.fromiter(
+                map(len, t_list), np.int32, count=len(t_list))
+            pp.a1 = np.where(ins, lens, a1)
+            if len(packed_tab):
+                a2_np[ann] = packed_tab[tidx[ann]]
+            pp.a2_np = a2_np
+            pp.prep_ms = (time.perf_counter() - _t0) * 1000
+            return pp
+        # ONE interner pass per unique payload/props entry: handles
+        # resolve into small per-batch TABLES (texts first, packed
+        # props after), and when the combined table fits a narrow
+        # index the wire ships u8/u16 indices + the tables instead
+        # of a resolved (R, O) i32 plane — the device gathers a2
+        # and insert lengths itself (rich-pack vectorization
+        # tentpole)
+        if texts is not None:
+            base_h = len(self._payloads)
+            self._payloads.extend((_TEXT, t) for t in texts)
+            handles_tab = np.arange(base_h, base_h + len(texts),
+                                    dtype=np.int32)
+            lens_tab = np.fromiter(map(len, texts), np.int32,
+                                   count=len(texts))
+        elif ins.any():
+            handles_tab = np.array([self._payload(_TEXT, text)],
+                                   np.int32)
+            lens_tab = np.array([len(text)], np.int32)
+        else:
+            handles_tab = np.zeros((1,), np.int32)
+            lens_tab = np.zeros((1,), np.int32)
+        T, P = len(handles_tab), len(packed_tab)
+        if T + P <= 256:
+            pp.rich_mode = 2
+        elif T + P <= 65536:
+            pp.rich_mode = 3
+        else:
+            pp.rich_mode = 1
+        if pp.rich_mode != 1:
+            # annotate indices shift past the text region; indices at
+            # remove/NOOP slots are never validated NOR used (the
+            # device zeroes a2 for those kinds and the gather clamps),
+            # so they ride as-is
+            tidx_eff = np.where(ann, tidx + T, tidx)
+            if texts is None and ins.any():
+                # broadcast-insert + props form: tidx only indexes the
+                # props table; inserts all take table entry 0
+                tidx_eff = np.where(ins, 0, tidx_eff)
+            pp.tidx_eff = tidx_eff
+            pp.tab_n = max(8, 1 << (T + P - 1).bit_length())
+            pp.tab_a2, pp.tab_len, pp.pooled = \
+                self._tab_buffers(pp.tab_n, T, P)
+            pp.tab_a2[:T] = handles_tab
+            pp.tab_a2[T:T + P] = packed_tab
+            pp.tab_len[:T] = lens_tab
+            # wire a1 for inserts is a placeholder (= a0, so spans stay
+            # 0 and positions stay narrow); the device substitutes the
+            # table length — the host never builds the lens plane
+            pp.a1 = np.where(ins, a0, a1)
+        else:               # huge tables: resolved i32 a2 plane
+            a2_np = np.zeros((R, O), np.int32)
+            a1_out = a1
+            if texts is not None:
+                a2_np[ins] = handles_tab[tidx[ins]]
+                a1_out = np.where(ins, lens_tab.take(tidx, mode="clip"),
+                                  a1)
+            elif ins.any():
+                a2_np[ins] = handles_tab[0]
+                a1_out = np.where(ins, lens_tab[0], a1)
+            if P:
+                a2_np[ann] = packed_tab[tidx[ann]]
+            pp.a2_np = a2_np
+            pp.a1 = a1_out
+        pp.prep_ms = (time.perf_counter() - _t0) * 1000
+        return pp
+
+    def prepack_planes(self, rows, kind, a0, a1, text: str = "",
+                       texts=None, tidx=None,
+                       props=None) -> Optional[PrepackedPlanes]:
+        """Pipelined-ingest hook: run the seq-independent pack work for a
+        wave AHEAD of its sequencing (concurrent with the previous wave's
+        dispatch) and hand the result to ``apply_planes(prepacked=...)``.
+
+        Returns ``None`` when the batch touches interval-holding rows:
+        that path mints one payload handle per ACKED op (anchor
+        identity), which depends on post-sequencing nack knowledge — the
+        caller must fall back to the inline pack (and, in a pipeline,
+        barrier until this wave's dispatch completes so handle order
+        stays serial). The raw ``kind`` plane is assumed all-acked;
+        nacked slots only affect unused table entries (exactly as the
+        inline path, which interns whole tables regardless of nacks)."""
+        kind = np.asarray(kind, np.int32)
+        ins = kind == int(OpKind.STR_INSERT)
+        if bool(self._iv_docs) and bool(ins.any()) \
+                and not self._iv_docs.isdisjoint(
+                    np.asarray(rows).reshape(-1).tolist()):
+            return None
+        return self._pack_payload_tables(
+            np.asarray(rows), kind, np.asarray(a0, np.int32),
+            np.asarray(a1, np.int32), text, texts, tidx, props)
+
     def apply_planes(self, rows, kind, a0, a1, seq_base, client_id, ref_seq,
                      text: str = "", min_seq=None, texts=None, tidx=None,
-                     props=None, min_ops=None) -> None:
+                     props=None, min_ops=None, prepacked=None) -> None:
         """Columnar apply: dense (R, O) already-sequenced op planes for the
         subset of doc rows ``rows`` (R,) — the ingest hot path (no per-op
         Python objects anywhere). Ops per doc apply in column order (the
@@ -657,132 +880,22 @@ class TensorStringStore(StringOpInterner):
                              "scatter would silently drop ops)")
         kind = np.asarray(kind, np.int32)
         ins = kind == int(OpKind.STR_INSERT)
-        ann = kind == int(OpKind.STR_ANNOTATE)
-        if ann.any() and props is None:
-            raise ValueError("annotate slots require the props table")
-        # interval anchors key by (payload handle, offset): two same-text
-        # inserts in one doc must NOT share a handle or the anchor becomes
-        # ambiguous (the per-message path mints one handle per op). A
-        # batch touching any interval-holding row therefore mints per-op
-        # handles and ships the resolved a2 plane; the dedup'd-table fast
-        # wire stays reserved for interval-free batches.
-        iv_handles = bool(self._iv_docs) and bool(ins.any()) \
-            and not self._iv_docs.isdisjoint(rows.tolist())
-        rich = not (texts is None and props is None) or iv_handles
         a0 = np.asarray(a0, np.int32)
         a1 = np.asarray(a1, np.int32)
-        rich_mode = 0          # wire form: 0 broadcast, 1 plane, 2/3 table
-        tab_a2 = tab_len = tidx_eff = None
-        tab_n = 0
-        if not rich:
-            # broadcast payload: a2 is one scalar handle
-            a2_np = np.array([self._payload(_TEXT, text)], np.int32)
-            a1 = np.where(ins, len(text), a1)
-        else:
-            if tidx is not None:
-                tidx = np.asarray(tidx, np.int32)
-            packed_tab = np.zeros((0,), np.int32)
-            if props is not None and ann.any():
-                self._has_props = True
-                packed_tab = np.empty((len(props),), np.int32)
-                cache = self._props_pack_cache
-                for j, p in enumerate(props):
-                    (key, value), = p.items()  # single-key by contract
-                    try:
-                        packed = cache.get((key, value))
-                    except TypeError:   # unhashable value: intern directly
-                        packed = None
-                    if packed is None:
-                        packed = (self._prop_plane(key)
-                                  << PROP_HANDLE_BITS) \
-                            | self._prop_handle(value)
-                        try:
-                            cache[(key, value)] = packed
-                        except TypeError:
-                            pass
-                    packed_tab[j] = packed
-            if iv_handles:
-                # per-op handle mint (anchor identity), resolved a2 plane
-                rich_mode = 1
-                base_h = len(self._payloads)
-                flat_ins = np.flatnonzero(ins.reshape(-1))
-                if texts is not None:
-                    t_list = [texts[j] for j in
-                              map(int, tidx.reshape(-1)[flat_ins])]
-                else:
-                    t_list = [text] * len(flat_ins)
-                self._payloads.extend((_TEXT, t) for t in t_list)
-                a2_np = np.zeros((R, O), np.int32)
-                a2_np.reshape(-1)[flat_ins] = np.arange(
-                    base_h, base_h + len(flat_ins), dtype=np.int32)
-                lens = np.zeros((R, O), np.int32)
-                lens.reshape(-1)[flat_ins] = np.fromiter(
-                    map(len, t_list), np.int32, count=len(t_list))
-                a1 = np.where(ins, lens, a1)
-                if len(packed_tab):
-                    a2_np[ann] = packed_tab[tidx[ann]]
-                T = P = 0
-            else:
-                # ONE interner pass per unique payload/props entry: handles
-                # resolve into small per-batch TABLES (texts first, packed
-                # props after), and when the combined table fits a narrow
-                # index the wire ships u8/u16 indices + the tables instead
-                # of a resolved (R, O) i32 plane — the device gathers a2
-                # and insert lengths itself (rich-pack vectorization
-                # tentpole)
-                if texts is not None:
-                    base_h = len(self._payloads)
-                    self._payloads.extend((_TEXT, t) for t in texts)
-                    handles_tab = np.arange(base_h, base_h + len(texts),
-                                            dtype=np.int32)
-                    lens_tab = np.fromiter(map(len, texts), np.int32,
-                                           count=len(texts))
-                elif ins.any():
-                    handles_tab = np.array([self._payload(_TEXT, text)],
-                                           np.int32)
-                    lens_tab = np.array([len(text)], np.int32)
-                else:
-                    handles_tab = np.zeros((1,), np.int32)
-                    lens_tab = np.zeros((1,), np.int32)
-                T, P = len(handles_tab), len(packed_tab)
-                if T + P <= 256:
-                    rich_mode = 2
-                elif T + P <= 65536:
-                    rich_mode = 3
-                else:
-                    rich_mode = 1
-            if iv_handles:
-                pass            # a2 plane + insert lens minted above
-            elif rich_mode != 1:
-                # annotate indices shift past the text region; indices at
-                # remove/NOOP slots are never validated NOR used (the
-                # device zeroes a2 for those kinds and the gather clamps),
-                # so they ride as-is
-                tidx_eff = np.where(ann, tidx + T, tidx)
-                if texts is None and ins.any():
-                    # broadcast-insert + props form: tidx only indexes the
-                    # props table; inserts all take table entry 0
-                    tidx_eff = np.where(ins, 0, tidx_eff)
-                tab_n = max(8, 1 << (T + P - 1).bit_length())
-                tab_a2 = np.zeros((tab_n,), np.int32)
-                tab_a2[:T] = handles_tab
-                tab_a2[T:T + P] = packed_tab
-                tab_len = np.zeros((tab_n,), np.int32)
-                tab_len[:T] = lens_tab
-                # wire a1 for inserts is a placeholder (= a0, so spans stay
-                # 0 and positions stay narrow); the device substitutes the
-                # table length — the host never builds the lens plane
-                a1 = np.where(ins, a0, a1)
-            else:               # huge tables: resolved i32 a2 plane
-                a2_np = np.zeros((R, O), np.int32)
-                if texts is not None:
-                    a2_np[ins] = handles_tab[tidx[ins]]
-                    a1 = np.where(ins, lens_tab.take(tidx, mode="clip"), a1)
-                elif ins.any():
-                    a2_np[ins] = handles_tab[0]
-                    a1 = np.where(ins, lens_tab[0], a1)
-                if P:
-                    a2_np[ann] = packed_tab[tidx[ann]]
+        # payload/props side of the pack: either handed in by the
+        # pipelined executor's pack worker (``prepacked``, built
+        # concurrent with the previous wave's dispatch) or built inline
+        # right here — identical code either way (_pack_payload_tables)
+        pp = prepacked
+        if pp is None:
+            pp = self._pack_payload_tables(rows, kind, a0, a1, text,
+                                           texts, tidx, props)
+        rich = pp.rich
+        rich_mode = pp.rich_mode
+        a2_np = pp.a2_np
+        tab_a2, tab_len, tab_n = pp.tab_a2, pp.tab_len, pp.tab_n
+        tidx_eff = pp.tidx_eff
+        a1 = pp.a1
 
         # vectorized client interning. Fast path: one writer per doc row in
         # this batch (the common live-collaboration window) — R dict hits,
@@ -1003,11 +1116,17 @@ class TensorStringStore(StringOpInterner):
                 # (the gather also drains the dispatch pipeline, so the
                 # planes it returns include this segment's ops)
                 self._slide_docs(slides)
+        self._tab_release(pp)
         #: host-packing vs device-dispatch wall per columnar apply — the
         #: breakdown behind the serving throughput number (dispatches are
-        #: async; device time is measured by the caller's end sync)
+        #: async; device time is measured by the caller's end sync).
+        #: ``prepack_ms`` is the payload/table build wall: when the wave
+        #: came through the pipelined executor that work ran OFF the
+        #: critical path (concurrent with the previous wave's dispatch)
+        #: and pack_ms counts only the inline remainder.
         self.last_apply_stats = {
             "pack_ms": (_t_prep - _t0) * 1000 + pack_ms,
+            "prepack_ms": pp.prep_ms if prepacked is not None else 0.0,
             "dispatch_ms": dispatch_ms,
             "segments": len(segments),
         }

@@ -53,6 +53,7 @@ import numpy as np
 from ..core.protocol import ColumnarWireKind
 from ..utils import tracing
 from ..utils.telemetry import REGISTRY
+from .ingest_pipeline import PipelinedIngestExecutor
 
 _HDR = struct.Struct("<BI")
 _OP_DTYPE = np.dtype([("row", "<u2"), ("kind", "u1"), ("a0", "<u2"),
@@ -263,12 +264,18 @@ class ColumnarAlfred:
     one device dispatch per window (the Alfred→Kafka batching role)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
-                 window_min_rows: int = 512, window_ms: float = 2.0):
+                 window_min_rows: int = 512, window_ms: float = 2.0,
+                 pipeline_depth: int = 2):
         self.engine = engine
         self.host = host
         self.port = port
         self.window_min_rows = window_min_rows
         self.window_ms = window_ms
+        # > 0: windows go through a PipelinedIngestExecutor of this depth
+        # (submit wave N+1 while wave N packs/dispatches; ack only after
+        # the durable append). 0 = the serial one-round-trip-per-window
+        # path.
+        self.pipeline_depth = pipeline_depth
         self.evictions = 0
         self.windows_flushed = 0
         self.ops_ingested = 0
@@ -280,6 +287,10 @@ class ColumnarAlfred:
         self._pending_ops = 0
         self._server: Optional[asyncio.AbstractServer] = None
         self._wake: Optional[asyncio.Event] = None
+        self._executor: Optional[PipelinedIngestExecutor] = None
+        self._waves_inflight = 0
+        self._capacity: Optional[asyncio.Event] = None
+        self._pipeline_error: Optional[BaseException] = None
 
     # ------------------------------------------------------------ ingest side
 
@@ -371,28 +382,90 @@ class ColumnarAlfred:
                 tidx[j, 0] = h
         self._pending_rows.extend(again)
         self._pending_ops -= n
-        with tracing.TRACER.maybe_root_span(
-                "columnar.flush_window", every=256, ops=int(n)):
-            res = self.engine.ingest_planes(
-                rows, client, cseq, ref, kind, a0, a1,
-                texts=texts or [""], tidx=tidx,
-                props=props or None)
-        seqs = np.asarray(res["seq"]).reshape(-1)
-        # fan the acks back, one frame per participating session
-        per_sess: Dict[_ColSession, list] = {}
-        for j, sess in enumerate(sessions):
-            per_sess.setdefault(sess, []).append(
-                [int(cseq[j, 0]), int(seqs[j])])
-        for sess, acks in per_sess.items():
-            sess._push_json({"t": "acks", "acks": acks})
+        if self._executor is not None:
+            # pipelined front door: hand the window to the executor and
+            # return — the NEXT window aggregates while this one packs/
+            # sequences/dispatches; acks fan back from the done callback
+            # only after the durable append commits (ack-after-durable)
+            with tracing.TRACER.maybe_root_span(
+                    "columnar.submit_window", every=256, ops=int(n)):
+                ticket = self._executor.submit(
+                    rows, client, cseq, ref, kind, a0, a1,
+                    texts=texts or [""], tidx=tidx,
+                    props=props or None)
+            self._waves_inflight += 1
+            loop = getattr(self, "_loop", None) or \
+                asyncio.get_running_loop()
+            ticket.add_done_callback(
+                lambda t: self._bounce_ack(loop, t, sessions, cseq))
+        else:
+            with tracing.TRACER.maybe_root_span(
+                    "columnar.flush_window", every=256, ops=int(n)):
+                res = self.engine.ingest_planes(
+                    rows, client, cseq, ref, kind, a0, a1,
+                    texts=texts or [""], tidx=tidx,
+                    props=props or None)
+            self._fan_acks(sessions, cseq,
+                           np.asarray(res["seq"]).reshape(-1))
         self.windows_flushed += 1
         self.ops_ingested += n
         REGISTRY.inc("columnar_windows_flushed")
         REGISTRY.inc("columnar_ops_ingested", n)
         return n
 
+    def _fan_acks(self, sessions: List[_ColSession], cseq: np.ndarray,
+                  seqs: np.ndarray) -> None:
+        """Fan a window's acks back, one frame per participating session."""
+        per_sess: Dict[_ColSession, list] = {}
+        for j, sess in enumerate(sessions):
+            per_sess.setdefault(sess, []).append(
+                [int(cseq[j, 0]), int(seqs[j])])
+        for sess, acks in per_sess.items():
+            sess._push_json({"t": "acks", "acks": acks})
+
+    def _bounce_ack(self, loop, ticket, sessions: List[_ColSession],
+                    cseq: np.ndarray) -> None:
+        """Ticket done-callback: runs on the executor's log worker —
+        bounce onto the event loop (session queues are loop-affine)."""
+        try:
+            loop.call_soon_threadsafe(self._ack_wave, ticket, sessions,
+                                      cseq)
+        except RuntimeError:
+            pass   # loop already closed (shutdown race): acks are moot
+
+    def _ack_wave(self, ticket, sessions: List[_ColSession],
+                  cseq: np.ndarray) -> None:
+        self._waves_inflight -= 1
+        if self._capacity is not None:
+            self._capacity.set()
+        err = ticket.error()
+        if err is not None:
+            if self._pipeline_error is None:
+                self._pipeline_error = err
+            # dict.fromkeys: dedupe sessions, preserve order
+            for sess in dict.fromkeys(sessions):
+                sess._push_json({"t": "error",
+                                 "message": f"ingest failed: {err}"})
+            if self._wake is not None:
+                self._wake.set()
+            return
+        self._fan_acks(sessions, cseq,
+                       np.asarray(ticket.result()["seq"]).reshape(-1))
+
+    async def _wait_capacity(self) -> None:
+        """Depth backpressure: park the flusher (event loop stays free to
+        aggregate more socket ops) until a wave's durable append frees an
+        in-flight slot."""
+        if self._executor is None:
+            return
+        while self._waves_inflight >= self._executor.depth \
+                and self._pipeline_error is None:
+            self._capacity.clear()
+            await self._capacity.wait()
+
     async def _flusher(self) -> None:
         self._wake = asyncio.Event()
+        self._capacity = asyncio.Event()
         while True:
             try:
                 await asyncio.wait_for(self._wake.wait(),
@@ -401,9 +474,14 @@ class ColumnarAlfred:
                 pass
             self._wake.clear()
             try:
+                if self._pipeline_error is not None:
+                    raise RuntimeError("pipelined ingest failed"
+                                       ) from self._pipeline_error
                 while len(self._pending_rows) >= self.window_min_rows:
+                    await self._wait_capacity()
                     self._flush_window(limit=self.window_min_rows)
                 if self._pending_rows:
+                    await self._wait_capacity()
                     self._flush_window()
             except Exception as e:   # poisoned engine / device fault:
                 # surface to every connected session, then stop serving
@@ -416,11 +494,14 @@ class ColumnarAlfred:
     # ----------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        if self.pipeline_depth > 0 and self._executor is None:
+            self._executor = PipelinedIngestExecutor(
+                self.engine, depth=self.pipeline_depth)
         self._server = await asyncio.start_server(
             self._accept, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
-        self._flush_task = asyncio.get_running_loop().create_task(
-            self._flusher())
+        self._flush_task = self._loop.create_task(self._flusher())
 
     async def _accept(self, reader, writer) -> None:
         await _ColSession(self, reader, writer).run()
@@ -450,11 +531,26 @@ class ColumnarAlfred:
         return self
 
     def stop(self) -> None:
+        ex = self._executor
+        if ex is not None:
+            # drain first: in-flight waves resolve (acks fan while the
+            # loop is still alive), final occupancy gauges publish
+            try:
+                ex.close()
+            except (RuntimeError, TimeoutError):
+                pass
+            self._executor = None
         loop = getattr(self, "_loop", None)
         if loop is not None:
             loop.call_soon_threadsafe(
                 lambda: [t.cancel() for t in asyncio.all_tasks(loop)])
             self._thread.join(timeout=5)
+
+    def pipeline_stats(self) -> Optional[dict]:
+        """Occupancy/overlap evidence from the live executor (None when
+        serial)."""
+        ex = self._executor
+        return None if ex is None else ex.stats()
 
 
 def connect_with_backoff(host: str, port: int, attempts: int = 5,

@@ -8,6 +8,7 @@ native library cannot be built (``available()`` reports which one you got).
 from __future__ import annotations
 
 import ctypes
+import threading
 from typing import Optional, Tuple
 
 import numpy as np
@@ -80,13 +81,21 @@ def available() -> bool:
 
 
 class NativeDeli:
-    """C++ sequencer handle with the Python DeliSequencer's surface."""
+    """C++ sequencer handle with the Python DeliSequencer's surface.
+
+    Thread safety: the C++ state is NOT internally synchronized, and the
+    pipelined ingest executor calls ``sequence_batch_rows`` from its own
+    worker thread while front-door event loops join/leave clients — one
+    Python-side lock serializes every native call (held for the whole C
+    call; the batch entry points release the GIL inside ctypes, so the
+    lock is the only thing keeping concurrent callers out)."""
 
     def __init__(self, _handle=None):
         lib = _load()
         if lib is None:
             raise RuntimeError("native sequencer unavailable (no toolchain)")
         self._lib = lib
+        self._lock = threading.Lock()
         self._h = _handle if _handle is not None else lib.deli_create()
 
     def __del__(self):
@@ -95,10 +104,14 @@ class NativeDeli:
             self._h = None
 
     def client_join(self, doc_id: str, client: int) -> int:
-        return self._lib.deli_client_join(self._h, doc_id.encode(), client)
+        with self._lock:
+            return self._lib.deli_client_join(self._h, doc_id.encode(),
+                                              client)
 
     def client_leave(self, doc_id: str, client: int) -> int:
-        return self._lib.deli_client_leave(self._h, doc_id.encode(), client)
+        with self._lock:
+            return self._lib.deli_client_leave(self._h, doc_id.encode(),
+                                               client)
 
     def sequence(self, doc_id: str, client: int, client_seq: int,
                  ref_seq: int, is_noop: bool = False
@@ -106,9 +119,10 @@ class NativeDeli:
                             Optional[NackReason]]:
         """(seq, min_seq, None) on success, (None, None, reason) on nack."""
         out_min = ctypes.c_int64()
-        seq = self._lib.deli_sequence(
-            self._h, doc_id.encode(), client, client_seq, ref_seq,
-            int(is_noop), ctypes.byref(out_min))
+        with self._lock:
+            seq = self._lib.deli_sequence(
+                self._h, doc_id.encode(), client, client_seq, ref_seq,
+                int(is_noop), ctypes.byref(out_min))
         if seq < 0:
             REGISTRY.inc("native_deli_nacks")
             return None, None, _NACK_BY_CODE[int(seq)]
@@ -129,11 +143,12 @@ class NativeDeli:
         out_seq = np.empty(n, np.int64)
         out_min = np.empty(n, np.int64)
         p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
-        self._lib.deli_sequence_batch(
-            self._h, doc_id.encode(), n,
-            p(clients, ctypes.c_int32), p(client_seqs, ctypes.c_int32),
-            p(ref_seqs, ctypes.c_int32), p(is_noop, ctypes.c_int32),
-            p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
+        with self._lock:
+            self._lib.deli_sequence_batch(
+                self._h, doc_id.encode(), n,
+                p(clients, ctypes.c_int32), p(client_seqs, ctypes.c_int32),
+                p(ref_seqs, ctypes.c_int32), p(is_noop, ctypes.c_int32),
+                p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
         nacks = int(np.count_nonzero(out_seq < 0))
         REGISTRY.inc("native_deli_batch_ops", n - nacks)
         if nacks:
@@ -142,7 +157,8 @@ class NativeDeli:
 
     def doc_handle(self, doc_id: str) -> int:
         """Dense row handle (session-local; re-register after restore)."""
-        return int(self._lib.deli_doc_handle(self._h, doc_id.encode()))
+        with self._lock:
+            return int(self._lib.deli_doc_handle(self._h, doc_id.encode()))
 
     def sequence_batch_rows(self, handles, clients, client_seqs, ref_seqs,
                             is_noop=None):
@@ -159,11 +175,12 @@ class NativeDeli:
         out_seq = np.empty(n, np.int64)
         out_min = np.empty(n, np.int64)
         p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
-        self._lib.deli_sequence_batch_rows(
-            self._h, n, p(handles, ctypes.c_int32),
-            p(clients, ctypes.c_int32), p(client_seqs, ctypes.c_int32),
-            p(ref_seqs, ctypes.c_int32), p(is_noop, ctypes.c_int32),
-            p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
+        with self._lock:
+            self._lib.deli_sequence_batch_rows(
+                self._h, n, p(handles, ctypes.c_int32),
+                p(clients, ctypes.c_int32), p(client_seqs, ctypes.c_int32),
+                p(ref_seqs, ctypes.c_int32), p(is_noop, ctypes.c_int32),
+                p(out_seq, ctypes.c_int64), p(out_min, ctypes.c_int64))
         nacks = int(np.count_nonzero(out_seq < 0))
         REGISTRY.inc("native_deli_batch_ops", n - nacks)
         if nacks:
@@ -172,19 +189,24 @@ class NativeDeli:
 
     def replay(self, doc_id: str, client: int, client_seq: int,
                ref_seq: int, seq: int, min_seq: int, type_: int) -> None:
-        self._lib.deli_replay(self._h, doc_id.encode(), client, client_seq,
-                              ref_seq, seq, min_seq, type_)
+        with self._lock:
+            self._lib.deli_replay(self._h, doc_id.encode(), client,
+                                  client_seq, ref_seq, seq, min_seq, type_)
 
     def doc_seq(self, doc_id: str) -> int:
-        return int(self._lib.deli_doc_seq(self._h, doc_id.encode()))
+        with self._lock:
+            return int(self._lib.deli_doc_seq(self._h, doc_id.encode()))
 
     def doc_min_seq(self, doc_id: str) -> int:
-        return int(self._lib.deli_doc_min_seq(self._h, doc_id.encode()))
+        with self._lock:
+            return int(self._lib.deli_doc_min_seq(self._h,
+                                                  doc_id.encode()))
 
     def checkpoint(self) -> bytes:
-        n = self._lib.deli_checkpoint(self._h, None, 0)
-        buf = ctypes.create_string_buffer(int(n))
-        self._lib.deli_checkpoint(self._h, buf, n)
+        with self._lock:
+            n = self._lib.deli_checkpoint(self._h, None, 0)
+            buf = ctypes.create_string_buffer(int(n))
+            self._lib.deli_checkpoint(self._h, buf, n)
         return buf.raw[:n]
 
     @classmethod

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -276,8 +277,19 @@ class ServingEngineBase:
         # set when the device state may be AHEAD of the durable log (a
         # log append failed after the merge was dispatched): every ingest
         # and summary refuses until the engine is rebuilt via load() —
-        # summarizing now would durably persist never-logged ops
+        # summarizing now would durably persist never-logged ops.
+        # With the pipelined ingest executor several waves can be
+        # sequenced-but-not-yet-logged AT ONCE (from different threads),
+        # so the sentinel is counter-backed: poison clears only when the
+        # LAST in-flight wave's durable append commits
+        # (_ingest_mark_logged); the lock covers counter+message together.
         self._poisoned: Optional[str] = None
+        self._poison_lock = threading.Lock()
+        self._seq_unlogged = 0
+        # deferred overflow harvest (set by the compact tail when waves
+        # are still in flight; the executor re-checks after a drain)
+        self._ov_recover_due = False
+        self._ingest_executor = None
         # ---- incremental-summary machinery (shared by every engine) ----
         # last summary + its dirty-detection baselines (doc seqs, row map,
         # interner table lengths — engine-specific extras)
@@ -412,7 +424,9 @@ class ServingEngineBase:
         metrics. Returns (out_seq, out_min, nacked mask, n_ok)."""
         out_seq, out_min = raw.sequence_batch_rows(
             handles, client, client_seq, ref_seq)
-        self._poisoned = f"{what} failed after sequencing"
+        with self._poison_lock:
+            self._seq_unlogged += 1
+            self._poisoned = f"{what} failed after sequencing"
         # crash here = batch sequenced, nothing durable, nothing acked; a
         # restarted engine (summary + log tail) must never see these seqs
         fault_point(SITE_INGEST_MID_BATCH, what=what)
@@ -438,7 +452,23 @@ class ServingEngineBase:
         self._col_part = (p + 1) % self.log.n_partitions
         self.log.append(int(p), record)
         self.partition_metrics[p].inc("appends")
-        self._poisoned = None
+        self._ingest_mark_logged()
+
+    def _ingest_mark_logged(self) -> None:
+        """One sequenced wave's durable append committed: poison clears
+        only when NO older sequenced-but-unlogged wave remains (pipelined
+        ingest keeps several in flight; any of them crashing must leave
+        the engine refusing summaries until rebuilt)."""
+        with self._poison_lock:
+            if self._seq_unlogged > 0:
+                self._seq_unlogged -= 1
+            if self._seq_unlogged == 0:
+                self._poisoned = None
+
+    def _ingest_inflight(self) -> int:
+        """Sequenced-but-unlogged wave count (pipelined ingest depth)."""
+        with self._poison_lock:
+            return self._seq_unlogged
 
     def connect(self, doc_id: str, client_id: int
                 ) -> SequencedDocumentMessage:
@@ -721,6 +751,28 @@ class ServingEngineBase:
         self._queue.sort(key=lambda dm: dm[1].seq)
 
 
+class _IngestWave:
+    """Per-wave carrier threaded through the four columnar-ingest stages
+    (``_ingest_prepare`` → ``_ingest_sequence`` → ``_ingest_dispatch`` →
+    ``_ingest_log``); the pipelined executor hands one of these from
+    worker to worker, the serial ``ingest_planes`` walks it in place."""
+    __slots__ = (
+        "t_start", "rows", "R", "O", "kind", "a0", "a1", "client",
+        "ref_seq", "text", "texts", "tidx", "props", "flat_client",
+        "flat_client_seq", "flat_ref_seq", "handles", "prepacked",
+        "pipelined", "prep_ms", "seq_ms", "out_seq", "out_min", "nacked",
+        "n_ok", "kind_eff", "seq_rs", "seq_base", "n_valid", "min_rs",
+        "compact_due", "ms_arr", "apply_stats", "ov_prev")
+
+    def __init__(self):
+        self.prepacked = None
+        self.pipelined = False
+        self.prep_ms = 0.0
+        self.seq_ms = 0.0
+        self.apply_stats = {}
+        self.ov_prev = None
+
+
 class StringServingEngine(ServingEngineBase):
     """Sequencer + durable log + batched device merge for many documents."""
 
@@ -940,10 +992,33 @@ class StringServingEngine(ServingEngineBase):
         window floor crosses a tombstone (see docs/INTERVALS.md) — no
         per-op submit() fallback."""
         self._check_poisoned()
+        w = self._ingest_prepare(rows, client, client_seq, ref_seq, kind,
+                                 a0, a1, text, texts, tidx, props)
+        self._ingest_sequence(w)
+        self._ingest_dispatch(w)
+        return self._ingest_log(w)
+
+    # ------------------------------------------- pipelined ingest stages
+    # ``ingest_planes`` above is the serial composition of four stage
+    # methods over an _IngestWave carrier; the pipelined executor
+    # (server.ingest_pipeline) calls the SAME stages from its worker
+    # threads so wave N+1's prepare/pack overlaps wave N's dispatch and
+    # wave N−1's log append. Thread contract: prepare runs on the pack
+    # worker (validation + payload prepack, FIFO), sequence+dispatch run
+    # on one thread (they share the sequencer and compaction cursors),
+    # log runs on the log worker (pure host I/O; acks fire after it).
+
+    def _ingest_prepare(self, rows, client, client_seq, ref_seq, kind,
+                        a0, a1, text="", texts=None, tidx=None,
+                        props=None, prepack=False) -> "_IngestWave":
+        """Stage 1 — validation, row-handle fill, plane flattening, and
+        (``prepack=True``, pipelined mode) the payload/table pack, all
+        independent of sequencing results."""
         raw = getattr(self.deli, "raw", None)
         if raw is None:
             raise RuntimeError("columnar ingest requires sequencer='native'")
-        self.flush()  # per-op queue first: per-doc seq order must hold
+        w = _IngestWave()
+        w.t_start = time.perf_counter()
         rows = np.ascontiguousarray(rows, np.int32)
         R, O = kind.shape
         if len(rows) != R or len(np.unique(rows)) != R:
@@ -998,40 +1073,65 @@ class StringServingEngine(ServingEngineBase):
             raise ValueError("payload/props tables require the tidx plane")
 
         self._fill_row_handles(rows, raw)
+        w.rows, w.R, w.O = rows, R, O
+        w.kind = kind
+        w.a0 = np.ascontiguousarray(np.asarray(a0, np.int32))
+        w.a1 = np.ascontiguousarray(np.asarray(a1, np.int32))
+        w.client = np.ascontiguousarray(np.asarray(client, np.int32))
+        w.ref_seq = np.ascontiguousarray(np.asarray(ref_seq, np.int32))
+        w.text, w.texts, w.tidx, w.props = text, texts, tidx, props
+        w.flat_client = w.client.reshape(-1)
+        w.flat_client_seq = np.ascontiguousarray(
+            np.asarray(client_seq, np.int32).reshape(-1))
+        w.flat_ref_seq = w.ref_seq.reshape(-1)
+        w.handles = np.repeat(self._row_handle[rows], O)
+        _t_val = time.perf_counter()
+        w.prep_ms = (_t_val - w.t_start) * 1000
+        if prepack:
+            w.pipelined = True
+            # payload/table pack AHEAD of sequencing (overlaps the
+            # previous wave's device dispatch). None = interval batch:
+            # the executor barriers and the dispatch stage packs inline.
+            w.prepacked = self.store.prepack_planes(
+                rows, kind, w.a0, w.a1, text, texts, tidx, props)
+        return w
 
-        t0 = time.perf_counter()
-        flat = lambda p: np.ascontiguousarray(np.asarray(p, np.int32)
-                                              .reshape(-1))
-        handles = np.repeat(self._row_handle[rows], O)
+    def _ingest_sequence(self, w: "_IngestWave") -> None:
+        """Stage 2 — ONE native sequencing call + the post-seq plane math
+        (nack masking, per-row seq bases, window-floor fold)."""
+        raw = self.deli.raw
+        _t0 = time.perf_counter()
+        self.flush()  # per-op queue first: per-doc seq order must hold
         out_seq, out_min, nacked, n_ok = self._sequence_columnar(
-            raw, handles, flat(client), flat(client_seq), flat(ref_seq),
-            "columnar batch")
+            raw, w.handles, w.flat_client, w.flat_client_seq,
+            w.flat_ref_seq, "columnar batch")
         _t_seq = time.perf_counter()
-
-        # device merge FIRST (async dispatch — see docstring): nacked slots
-        # become NOOP (they consumed no seq); the store rebuilds per-op seqs
-        # on device from each doc's base — only narrow planes cross the
-        # host→device link (ref clamps on device). On a compaction-due
-        # flush, zamboni fuses into the SAME dispatch.
+        w.out_seq, w.out_min, w.nacked, w.n_ok = out_seq, out_min, \
+            nacked, n_ok
+        R, O = w.R, w.O
+        # nacked slots become NOOP (they consumed no seq); the store
+        # rebuilds per-op seqs on device from each doc's base — only
+        # narrow planes cross the host→device link (ref clamps on device)
         valid_rs = (~nacked).reshape(R, O)
-        kind_eff = np.where(valid_rs, kind, int(OpKind.NOOP))
-        seq_rs = out_seq.reshape(R, O)
-        n_valid = valid_rs.sum(axis=1)
-        seq_base = (np.max(np.where(valid_rs, seq_rs, 0), axis=1)
-                    - n_valid).astype(np.int32)
+        w.kind_eff = np.where(valid_rs, w.kind, int(OpKind.NOOP))
+        w.seq_rs = out_seq.reshape(R, O)
+        w.n_valid = valid_rs.sum(axis=1)
+        w.seq_base = (np.max(np.where(valid_rs, w.seq_rs, 0), axis=1)
+                      - w.n_valid).astype(np.int32)
         # window-floor tracking for zamboni: fold this batch's MSN advance
         # in BEFORE building the fused compaction floor, so a compaction-due
         # batch zambonis at the post-batch floor (not one batch stale)
-        min_rs = out_min.reshape(R, O)
-        last_min = min_rs[:, -1]
+        w.min_rs = out_min.reshape(R, O)
+        last_min = w.min_rs[:, -1]
         # C-level dict bulk update (zip over plain-int lists), not a
         # 10k-iteration Python loop with an int() per row
         rdi = self._row_doc_id
-        self._min_seq.update(zip((rdi[r] for r in rows.tolist()),
+        self._min_seq.update(zip((rdi[r] for r in w.rows.tolist()),
                                  last_min.tolist()))
-        compact_due = self._flushes_since_compact + 1 >= self.compact_every
-        ms_arr = None
-        if compact_due:
+        w.compact_due = \
+            self._flushes_since_compact + 1 >= self.compact_every
+        w.ms_arr = None
+        if w.compact_due:
             ms_arr = np.zeros((self.n_docs,), np.int32)
             dr = self._doc_rows
             if dr:
@@ -1039,96 +1139,33 @@ class StringServingEngine(ServingEngineBase):
                 ms_arr[np.fromiter(dr.values(), np.int32, count=len(dr))] \
                     = np.fromiter((g(d, 0) for d in dr), np.int64,
                                   count=len(dr))
+            w.ms_arr = ms_arr
+        w.seq_ms = (_t_seq - _t0) * 1000
+        w.prep_ms += (time.perf_counter() - _t_seq) * 1000
+
+    def _ingest_dispatch(self, w: "_IngestWave") -> None:
+        """Stage 3 — the async device merge (zamboni fuses into the same
+        dispatch on a compaction-due wave) + compaction cadence."""
         # degradation injection: an armed plan may stall the device apply
-        # here (tunnel RTT spike); the watchdog below must surface it
+        # here (tunnel RTT spike); the watchdog must surface it
         fault_point(SITE_APPLY_STALL, what="ingest_planes")
+        pp = w.prepacked
+        if pp is not None and getattr(self.store, "_iv_docs", None) \
+                and not self.store._iv_docs.isdisjoint(w.rows.tolist()):
+            # intervals appeared on a targeted row between prepack and
+            # apply (interval mutation racing the pipeline): fall back to
+            # the inline pack, which mints the per-op anchor handles
+            self.store._tab_release(pp)
+            pp = w.prepacked = None
         self.store.apply_planes(
-            rows, kind_eff, np.asarray(a0, np.int32),
-            np.asarray(a1, np.int32), seq_base,
-            np.asarray(client, np.int32),
-            np.asarray(ref_seq, np.int32), text, min_seq=ms_arr,
-            texts=texts, tidx=tidx, props=props, min_ops=min_rs)
-        _t_apply = time.perf_counter()
+            w.rows, w.kind_eff, w.a0, w.a1, w.seq_base, w.client,
+            w.ref_seq, w.text, min_seq=w.ms_arr, texts=w.texts,
+            tidx=w.tidx, props=w.props, min_ops=w.min_rs, prepacked=pp)
         self._ensure_shard_collectors()
-        self._note_shard_ops(rows, counts=n_valid)
-
-        # durable log (host work, overlapped with the device apply)
-        ts = self.deli.clock()
-        rowidx = np.repeat(np.arange(R, dtype=np.int32), O)
-        ids = [self._row_doc_id[r] for r in rows]
-        flat_client = flat(client)
-        ref_clamped = self._clamped_ref(flat(ref_seq), out_seq)
-        if not nacked.any():
-            # hot path: the whole batch is ONE ColumnarOps record (the
-            # Kafka-batch analog) — no partition sort, no per-field
-            # gathers; a doc's columnar history is reassembled seq-ordered
-            # at read (_doc_log_messages scans all partitions — recovery
-            # only). Copies detach the log from caller-owned planes.
-            self._append_columnar(ColumnarOps(
-                ids, rowidx, flat_client.copy(), flat(client_seq).copy(),
-                ref_clamped, out_seq, out_min, kind.reshape(-1).copy(),
-                flat(a0).copy(), flat(a1).copy(), text=text, timestamp=ts,
-                texts=texts, props=props,
-                tidx=None if tidx is None else flat(tidx).copy()))
-        else:
-            # nacked slots present (rare): group the survivors by doc
-            # partition with ONE stable sort, one record per partition
-            parts = np.repeat(self._row_part[rows], O)
-            ok_idx = np.flatnonzero(~nacked)
-            order = ok_idx[np.argsort(parts[ok_idx], kind="stable")]
-            p_sorted = parts[order]
-            bounds = np.searchsorted(
-                p_sorted, np.arange(self.log.n_partitions + 1))
-            fields = (flat_client, flat(client_seq), ref_clamped,
-                      out_seq, out_min, kind.reshape(-1), flat(a0),
-                      flat(a1))
-            gathered = tuple(f[order] for f in fields)
-            row_sorted = rowidx[order]
-            tidx_flat = None if tidx is None else flat(tidx)[order]
-            for p in range(self.log.n_partitions):
-                lo, hi = bounds[p], bounds[p + 1]
-                if lo == hi:
-                    continue
-                sl = slice(lo, hi)
-                self.log.append(int(p), ColumnarOps(
-                    ids, row_sorted[sl], *(g[sl] for g in gathered),
-                    text=text, timestamp=ts, texts=texts, props=props,
-                    tidx=None if tidx_flat is None else tidx_flat[sl]))
-            self._poisoned = None  # sequence → merge → log completed
-        # per-stage host wall (the throughput breakdown): C++ sequencing,
-        # plane prep + wire packing, async device dispatch, log append —
-        # device time itself is covered by the caller's end sync
-        _t_log = time.perf_counter()
-        st = getattr(self.store, "last_apply_stats", None) or {}
-        self.metrics.observe("ingest_seq_ms", (_t_seq - t0) * 1000)
-        self.metrics.observe("ingest_pack_ms", st.get("pack_ms", 0.0))
-        self.metrics.observe("ingest_dispatch_ms",
-                             st.get("dispatch_ms", 0.0))
-        self.metrics.observe(
-            "ingest_prep_ms",
-            (_t_apply - _t_seq) * 1000 - st.get("pack_ms", 0.0)
-            - st.get("dispatch_ms", 0.0))
-        self.metrics.observe("ingest_log_ms", (_t_log - _t_apply) * 1000)
-
-        if self._attributors is not None:
-            ok = ~nacked
-            for doc_local, s, c in zip(rowidx[ok], out_seq[ok],
-                                       flat_client[ok]):
-                self._attributor_of(ids[int(doc_local)]).record_raw(
-                    int(s), int(c), ts)
-        self.metrics.inc("flushes")
-        self.metrics.inc("ops_flushed", n_ok)
-        elapsed_ms = (time.perf_counter() - t0) * 1000
-        self.metrics.observe("flush_ms", elapsed_ms)
-        tracing.TRACER.record_complete(
-            "serving.ingest_planes", elapsed_ms, ops=int(n_ok),
-            nacked=int(nacked.sum()),
-            seq_ms=(_t_seq - t0) * 1000,
-            pack_ms=st.get("pack_ms", 0.0),
-            dispatch_ms=st.get("dispatch_ms", 0.0),
-            log_ms=(_t_log - _t_apply) * 1000)
-        self._watch_apply(elapsed_ms, "ingest_planes", n_ok)
-        if compact_due:
+        self._note_shard_ops(w.rows, counts=w.n_valid)
+        w.apply_stats = dict(getattr(self.store, "last_apply_stats",
+                                     None) or {})
+        if w.compact_due:
             self._flushes_since_compact = 0
             self.metrics.inc("compactions")
             if self.mega_store is not None and self._mega_rows:
@@ -1145,7 +1182,7 @@ class StringServingEngine(ServingEngineBase):
                 # the flags now and inspect the PREVIOUS compaction's copy
                 # (already landed) — detection is one compaction late,
                 # which only delays recovery (the log has every acked op).
-                prev = self._ov_pending
+                w.ov_prev = self._ov_pending
                 # jnp.copy: the live overflow buffer is donated away by
                 # the next merge; the stash must own its storage
                 import jax.numpy as jnp
@@ -1154,11 +1191,114 @@ class StringServingEngine(ServingEngineBase):
                     self._ov_pending.copy_to_host_async()
                 except (AttributeError, RuntimeError):
                     pass
-                if prev is not None and np.asarray(prev).any():
-                    self.recover_overflowed()
         else:
             self._flushes_since_compact += 1
-        return {"seq": seq_rs, "nacked": int(nacked.sum())}
+
+    def _ingest_log(self, w: "_IngestWave") -> dict:
+        """Stage 4 — the durable whole-batch append (ack barrier: poison
+        clears and callers may ack only after this commits), metrics,
+        attribution, watchdog."""
+        _t_apply = time.perf_counter()
+        ts = self.deli.clock()
+        R, O = w.R, w.O
+        rows, kind, nacked = w.rows, w.kind, w.nacked
+        out_seq, out_min = w.out_seq, w.out_min
+        text, texts, tidx, props = w.text, w.texts, w.tidx, w.props
+        rowidx = np.repeat(np.arange(R, dtype=np.int32), O)
+        ids = [self._row_doc_id[r] for r in rows]
+        flat_client = w.flat_client
+        ref_clamped = self._clamped_ref(w.flat_ref_seq, out_seq)
+        flat_tidx = None if tidx is None else np.ascontiguousarray(
+            np.asarray(tidx, np.int32).reshape(-1))
+        if not nacked.any():
+            # hot path: the whole batch is ONE ColumnarOps record (the
+            # Kafka-batch analog) — no partition sort, no per-field
+            # gathers; a doc's columnar history is reassembled seq-ordered
+            # at read (_doc_log_messages scans all partitions — recovery
+            # only). Copies detach the log from caller-owned planes.
+            self._append_columnar(ColumnarOps(
+                ids, rowidx, flat_client.copy(),
+                w.flat_client_seq.copy(), ref_clamped, out_seq, out_min,
+                kind.reshape(-1).copy(), w.a0.reshape(-1).copy(),
+                w.a1.reshape(-1).copy(), text=text, timestamp=ts,
+                texts=texts, props=props,
+                tidx=None if flat_tidx is None else flat_tidx.copy()))
+        else:
+            # nacked slots present (rare): group the survivors by doc
+            # partition with ONE stable sort, one record per partition
+            parts = np.repeat(self._row_part[rows], O)
+            ok_idx = np.flatnonzero(~nacked)
+            order = ok_idx[np.argsort(parts[ok_idx], kind="stable")]
+            p_sorted = parts[order]
+            bounds = np.searchsorted(
+                p_sorted, np.arange(self.log.n_partitions + 1))
+            fields = (flat_client, w.flat_client_seq, ref_clamped,
+                      out_seq, out_min, kind.reshape(-1),
+                      w.a0.reshape(-1), w.a1.reshape(-1))
+            gathered = tuple(f[order] for f in fields)
+            row_sorted = rowidx[order]
+            tidx_flat = None if flat_tidx is None else flat_tidx[order]
+            for p in range(self.log.n_partitions):
+                lo, hi = bounds[p], bounds[p + 1]
+                if lo == hi:
+                    continue
+                sl = slice(lo, hi)
+                self.log.append(int(p), ColumnarOps(
+                    ids, row_sorted[sl], *(g[sl] for g in gathered),
+                    text=text, timestamp=ts, texts=texts, props=props,
+                    tidx=None if tidx_flat is None else tidx_flat[sl]))
+            self._ingest_mark_logged()  # sequence → merge → log completed
+        # per-stage host wall (the throughput breakdown): C++ sequencing,
+        # plane prep + wire packing, async device dispatch, log append —
+        # device time itself is covered by the caller's end sync. In
+        # pipelined mode ``ingest_prepack_ms`` is the pack work that ran
+        # OFF the critical path (pack worker, overlapped with the
+        # previous wave's dispatch).
+        _t_log = time.perf_counter()
+        log_ms = (_t_log - _t_apply) * 1000
+        st = w.apply_stats
+        self.metrics.observe("ingest_seq_ms", w.seq_ms)
+        self.metrics.observe("ingest_pack_ms", st.get("pack_ms", 0.0))
+        self.metrics.observe("ingest_dispatch_ms",
+                             st.get("dispatch_ms", 0.0))
+        self.metrics.observe("ingest_prep_ms", w.prep_ms)
+        self.metrics.observe("ingest_log_ms", log_ms)
+        prepack_ms = st.get("prepack_ms", 0.0)
+        if prepack_ms:
+            self.metrics.observe("ingest_prepack_ms", prepack_ms)
+
+        if self._attributors is not None:
+            ok = ~nacked
+            for doc_local, s, c in zip(rowidx[ok], out_seq[ok],
+                                       flat_client[ok]):
+                self._attributor_of(ids[int(doc_local)]).record_raw(
+                    int(s), int(c), ts)
+        self.metrics.inc("flushes")
+        self.metrics.inc("ops_flushed", w.n_ok)
+        busy_ms = (w.seq_ms + w.prep_ms + st.get("pack_ms", 0.0)
+                   + prepack_ms + st.get("dispatch_ms", 0.0) + log_ms)
+        # pipelined waves sit in stage queues between workers; wall time
+        # since submission would count that waiting as a stall, so the
+        # watchdog judges the wave's BUSY time instead
+        elapsed_ms = busy_ms if w.pipelined \
+            else (time.perf_counter() - w.t_start) * 1000
+        self.metrics.observe("flush_ms", elapsed_ms)
+        tracing.TRACER.record_complete(
+            "serving.ingest_planes", elapsed_ms, ops=int(w.n_ok),
+            nacked=int(nacked.sum()), seq_ms=w.seq_ms,
+            pack_ms=st.get("pack_ms", 0.0),
+            dispatch_ms=st.get("dispatch_ms", 0.0), log_ms=log_ms)
+        self._watch_apply(elapsed_ms, "ingest_planes", w.n_ok)
+        # overflow harvest decision rides AFTER the durable append —
+        # recovery replays the LOG, so it must see this wave's record.
+        # Pipelined: defer to the executor's drain (other waves may still
+        # be sequencing on another thread).
+        if w.ov_prev is not None and np.asarray(w.ov_prev).any():
+            if w.pipelined:
+                self._ov_recover_due = True
+            else:
+                self.recover_overflowed()
+        return {"seq": w.seq_rs, "nacked": int(nacked.sum())}
 
     # ----------------------------------------------------------- device side
 

@@ -259,3 +259,113 @@ def test_replica_full_sheds_visibly():
 def test_injected_apply_stall_is_observable(family):
     report = chaos.run_stall_drill(31, family=family)
     assert report["stalls"] >= 1 and report["events"] >= 1
+
+
+# ------------------------------------- pipelined ingest crash drill
+
+def test_pipelined_crash_between_sequencing_and_append():
+    """ISSUE 6 drill: with several waves in flight in the staged ingest
+    pipeline, crash the seq worker AFTER native sequencing but BEFORE
+    the wave's durable append (``SITE_INGEST_MID_BATCH``). The recovery
+    contract must hold across the overlap: every ACKED wave is durably
+    logged; the crashed wave's seqs exist nowhere durable; the engine
+    stays poisoned (refuses summaries) until rebuilt; and two rebuilds
+    from the same summary + log converge byte-for-byte."""
+    import numpy as np
+
+    from fluidframework_tpu.server import native_deli
+    if not native_deli.available():
+        pytest.skip("native sequencer unavailable")
+    from fluidframework_tpu.ops.merge_tree_kernel import string_state_digest
+    from fluidframework_tpu.server.ingest_pipeline import (
+        PipelinedIngestExecutor,
+    )
+    from fluidframework_tpu.server.serving import StringServingEngine
+    from fluidframework_tpu.testing.synthetic import typing_storm
+    from fluidframework_tpu.utils.faultpoints import SITE_INGEST_MID_BATCH
+
+    R, O = 4, 4
+    eng = StringServingEngine(n_docs=R, capacity=256,
+                              batch_window=10 ** 9, sequencer="native")
+    docs = [f"d{i}" for i in range(R)]
+    for d in docs:
+        eng.connect(d, 1)
+    summary0 = eng.summarize()  # recovery replays the whole storm tail
+    rows = np.array([eng.doc_row(d) for d in docs], np.int32)
+    client = np.ones((R, O), np.int32)
+
+    CRASH_WAVE = 2                      # 0-based; third sequencing hit
+    plan = chaos.FaultPlan(crash={SITE_INGEST_MID_BATCH: CRASH_WAVE + 1})
+    ex = PipelinedIngestExecutor(eng, depth=2)
+    tickets = []
+    seq = 1
+    with armed(plan):
+        for b in range(5):
+            planes, seq = typing_storm(R, O, seed=b, start_seq=seq)
+            cs = np.broadcast_to(
+                np.arange(b * O + 1, (b + 1) * O + 1, dtype=np.int32),
+                (R, O))
+            try:
+                tickets.append(ex.submit(rows, client, cs, cs,
+                                         planes["kind"], planes["a0"],
+                                         planes["a1"], text="ab"))
+            except RuntimeError:
+                break  # fail-stop: the executor already refused new work
+        with pytest.raises(RuntimeError) as ei:
+            ex.drain()
+    assert plan.fired == [SITE_INGEST_MID_BATCH]
+    assert isinstance(ei.value.__cause__, CrashInjected)
+
+    # acks are exactly the pre-crash waves; everything after fails
+    acked_waves, acked_keys = [], set()
+    for b, t in enumerate(tickets):
+        if b < CRASH_WAVE:
+            res = t.result(timeout=5)
+            assert res["nacked"] == 0
+            acked_waves.append(b)
+            for d in docs:
+                for c in range(O):
+                    acked_keys.add((d, b * O + c + 1))
+        else:
+            err = t.error()
+            assert err is not None, f"wave {b} must not ack past a crash"
+            if b == CRASH_WAVE:
+                assert isinstance(err, CrashInjected)
+    assert acked_waves == list(range(CRASH_WAVE))
+
+    # no acked op lost / no phantom seqs: the durable log holds exactly
+    # the acked waves' ops — none of the crashed wave's sequenced cseqs
+    logged = {(m.doc_id, m.client_seq) for m in chaos.logged_ops(eng)}
+    assert acked_keys <= logged
+    crashed_keys = {(d, CRASH_WAVE * O + c + 1)
+                    for d in docs for c in range(O)}
+    assert not (crashed_keys & logged)
+
+    # the victim is poisoned by design: device/sequencer state is ahead
+    # of the log, so summaries (and new ingest) must be refused
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.summarize()
+    with pytest.raises(RuntimeError):
+        ex.submit(rows, client, client, client,
+                  np.zeros((R, O), np.int32), np.zeros((R, O), np.int32),
+                  np.zeros((R, O), np.int32), text="x")
+    ex.close()
+
+    # deterministic replay: two independent rebuilds from the same
+    # summary + log converge, carry every acked op, and the crashed
+    # wave's seqs are gone (doc seq == the acked tail)
+    twins = [StringServingEngine.load(summary0, eng.log,
+                                      sequencer="native")
+             for _ in range(2)]
+    d0 = np.asarray(string_state_digest(twins[0].store.state))
+    d1 = np.asarray(string_state_digest(twins[1].store.state))
+    assert (d0 == d1).all()
+    for d in docs:
+        assert twins[0].read_text(d) == twins[1].read_text(d)
+    # post-recovery ingest resumes exactly after the acked tail
+    t0 = twins[0]
+    base = t0.deli.doc_seq(docs[0])
+    msg, nack = t0.submit(docs[0], 1, CRASH_WAVE * O + 1, base,
+                          {"mt": "insert", "kind": 0, "pos": 0,
+                           "text": "z", "clientSeq": CRASH_WAVE * O + 1})
+    assert nack is None and msg.seq == base + 1

@@ -21,6 +21,9 @@ records. This tool is the mechanical judge (ISSUE 4 tentpole piece 4):
 
 Direction is inferred from the name (``*ops_per_sec*`` up is good,
 ``*_ms``/``*_retries`` down is good); parity booleans are must-hold.
+On top of the relative bands, DECLARED_FLOORS carries absolute
+per-metric bars (e.g. ``serving_rich_ops_per_sec >= 2e6``) that arm
+once achieved and then fail ``--check`` on any later dip below.
 ``--write-md`` refreshes the ``## Trajectory`` section in BENCHES.md;
 ``--check`` is the quiet tier-1 mode (table only on failure). bench.py
 imports :func:`judge` to embed a live verdict in its own record.
@@ -64,6 +67,17 @@ MUST_HOLD = {"digest_parity", "conflict_parity"}
 #: the tunnel's property not the code's, and config constants are inputs
 INFO_PATTERNS = ("worst",)
 INFO_EXACT = {"dispatch_rtt_ms", "docs", "total_ops", "contended"}
+
+#: declared per-metric floors (ISSUE 6 satellite): absolute bars the
+#: roadmap has committed to, judged in --check tier-1 mode alongside the
+#: trajectory bands. A floor only ARMS once some prior round achieved it
+#: ("once achieved"): a still-climbing metric is never failed
+#: retroactively, but any later round dipping back below an armed floor
+#: fails the build even if the dip sits inside the variance band.
+DECLARED_FLOORS: Dict[str, float] = {
+    "serving_rich_ops_per_sec": 2e6,
+    "columnar_ingress_ops_per_sec": 45e3,
+}
 
 
 def classify(name: str) -> Optional[str]:
@@ -178,6 +192,38 @@ def judge(rounds: List[dict], rel_band: float = 0.10,
     return verdicts
 
 
+def judge_floors(rounds: List[dict]) -> List[dict]:
+    """Declared-floor verdicts for the newest round (see
+    DECLARED_FLOORS). Unarmed floors (never achieved in a prior round)
+    report ``info``; armed floors report ``flat`` while they hold and
+    ``regress`` the moment a round lands below them."""
+    if not rounds:
+        return []
+    newest, priors = rounds[-1], rounds[:-1]
+    out: List[dict] = []
+    for name, floor in sorted(DECLARED_FLOORS.items()):
+        val = newest.get(name)
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            continue
+        armed = any(
+            isinstance(r.get(name), (int, float))
+            and not isinstance(r.get(name), bool)
+            and float(r[name]) >= floor for r in priors)
+        if val >= floor:
+            verdict = FLAT
+            note = "floor holds" if armed else "floor achieved (now armed)"
+        elif armed:
+            verdict, note = REGRESS, "below an ACHIEVED declared floor"
+        else:
+            verdict, note = INFO, "floor not yet achieved (unarmed)"
+        out.append({"metric": name, "verdict": verdict, "value": val,
+                    "expected": f">={floor:g} (declared floor)",
+                    "delta_pct": round((float(val) - floor) / floor * 100,
+                                       2),
+                    "note": note})
+    return out
+
+
 def has_regression(verdicts: List[dict]) -> bool:
     return any(v["verdict"] == REGRESS for v in verdicts)
 
@@ -271,6 +317,7 @@ def main(argv=None) -> int:
         return 0
     verdicts = judge(rounds, rel_band=args.rel_band,
                      k_sigma=args.k_sigma)
+    verdicts += judge_floors(rounds)
     failed = has_regression(verdicts)
     if args.json:
         print(json.dumps(verdicts, indent=2))
