@@ -624,10 +624,13 @@ def run():
     # record per wave. Clients pre-encode (their serialization cost, as
     # with ingest_planes' packing); oracle parity asserted from the log.
     from fluidframework_tpu.server.serving import TreeServingEngine
-    from fluidframework_tpu.server.tree_wire import encode_tree_batch
+    from fluidframework_tpu.server.tree_wire import (encode_leaf_records,
+                                                     encode_tree_batch)
     n_tree_docs = 8192
     tree_opd = 8            # transactions per doc per wave
-    n_tree_waves = 3        # measured waves per trial (after warmup)
+    n_tree_waves = 6        # measured waves per trial (after warmup;
+    #                         6 waves through a depth-3 pipeline reach
+    #                         steady-state overlap — 3 barely fill it)
     tdocs = [f"t-{i}" for i in range(n_tree_docs)]
     tree_n_ops = n_tree_docs * tree_opd
 
@@ -678,6 +681,11 @@ def run():
     tree_ones = np.ones(tree_n_ops, np.int32)
 
     def _tree_trial():
+        """Pipelined trial (the string serving phases' executor idiom):
+        wave N+1's wire prepack + sequencing overlap wave N's device
+        dispatch while N−1's durable append completes in the background;
+        drain() ends the timed section at the last wave's ack-safe
+        point."""
         eng = fresh_tree_engine()
         batches = tree_batches(eng)
         trows = np.repeat(
@@ -686,23 +694,30 @@ def run():
         eng.ingest_records(None, tree_ones, tree_cseqs(0), tree_zero,
                            batches[0], rows=trows)   # warmup + compile
         _ = eng.sync()
+        ex = PipelinedIngestExecutor(eng, depth=3)
         t0 = time.perf_counter()
-        for w, b in enumerate(batches[1:]):
-            res = eng.ingest_records(None, tree_ones, tree_cseqs(w + 1),
-                                     tree_zero, b, rows=trows)
-            assert res["nacked"] == 0
+        tickets = [ex.submit(None, tree_ones, tree_cseqs(w + 1),
+                             tree_zero, b, rows=trows)
+                   for w, b in enumerate(batches[1:])]
+        ex.drain()
         ovf = eng.sync()
         rate = n_tree_waves * tree_n_ops / (time.perf_counter() - t0)
         assert not ovf.any(), "tree capacity overflow in bench"
-        return eng, rate
+        for tk in tickets:
+            assert tk.result()["nacked"] == 0
+        pipe_stats = ex.stats()
+        ex.close()
+        return eng, rate, pipe_stats
 
     tree_trials = []
     tree_eng = None
+    tree_pipe_stats = None
     for _t in range(3):
-        eng_t, rate = _tree_trial()
+        eng_t, rate, pstats = _tree_trial()
         tree_trials.append(rate)
         if rate >= max(tree_trials):
             tree_eng = eng_t
+            tree_pipe_stats = pstats
         else:
             del eng_t
     tree_trials.sort()
@@ -710,38 +725,69 @@ def run():
     tree_ops_per_sec_median = tree_trials[len(tree_trials) // 2]
 
     # the tree VOLUME path: flat single-node inserts, ONE solo record per
-    # op through the same columnar pipeline
+    # op, pre-encoded by clients (``encode_leaf_records`` — their
+    # serialization cost, exactly like the general phase's
+    # ``encode_tree_batch``) and ingested through the SAME
+    # ``ingest_records`` pipeline the general path uses. One record per
+    # op instead of the transaction path's three, so flat ≥ general by
+    # construction. 8 leaves/doc/wave matches the general phase's op
+    # volume (65536 ops/wave).
     n_leaf_docs = n_tree_docs
+    leaf_opd = tree_opd
     ldocs = [f"tf-{i}" for i in range(n_leaf_docs)]
-    ones = [1] * n_leaf_docs
-    n_leaf_waves = 6
+    n_leaf_waves = n_tree_waves
+    leaf_n_ops = n_leaf_docs * leaf_opd
+    leaf_ones = np.ones(leaf_n_ops, np.int32)
+    leaf_zero = np.zeros(leaf_n_ops, np.int32)
+
+    def leaf_batches(eng):
+        lbase = eng.allocate_node_ids(leaf_n_ops * (n_leaf_waves + 1))
+
+        def lid(i, k):
+            return f"#{lbase + i * leaf_opd * (n_leaf_waves + 1) + k}"
+
+        out = []
+        for wave in range(n_leaf_waves + 1):
+            nids, values, afters = [], [], []
+            for i in range(n_leaf_docs):
+                for j in range(leaf_opd):
+                    k = wave * leaf_opd + j
+                    nids.append(lid(i, k))
+                    values.append(k)
+                    afters.append(lid(i, k - 1) if k else None)
+            out.append(encode_leaf_records(
+                ["root"] * leaf_n_ops, ["kids"] * leaf_n_ops, nids,
+                values, ["leaf"] * leaf_n_ops, afters))
+        return out
+
+    def leaf_cseqs(wave):
+        return np.repeat(
+            np.arange(1, leaf_opd + 1)[None, :] + wave * leaf_opd,
+            n_leaf_docs, axis=0).reshape(-1)
 
     def _leaves_trial():
         eng = TreeServingEngine(n_docs=n_leaf_docs, capacity=128,
                                 batch_window=10 ** 9, sequencer="native")
         for d in ldocs:
             eng.connect(d, 1)
-        lbase = eng.allocate_node_ids(n_leaf_docs * (n_leaf_waves + 1))
-
-        def lid(i, wave):
-            return f"#{lbase + i * (n_leaf_waves + 1) + wave}"
-
-        eng.ingest_leaves(  # warmup (compiles the flat apply)
-            ldocs, ones, ones, [0] * n_leaf_docs, ["root"] * n_leaf_docs,
-            ["kids"] * n_leaf_docs,
-            [lid(i, 0) for i in range(n_leaf_docs)], [0] * n_leaf_docs)
+        lbs = leaf_batches(eng)
+        lrows = np.repeat(
+            np.array([eng.doc_row(d) for d in ldocs], np.int32),
+            leaf_opd)
+        eng.ingest_records(None, leaf_ones, leaf_cseqs(0), leaf_zero,
+                           lbs[0], rows=lrows)   # warmup + compile
         _ = eng.sync()
+        ex = PipelinedIngestExecutor(eng, depth=3)
         t0 = time.perf_counter()
-        for wave in range(1, n_leaf_waves + 1):
-            res = eng.ingest_leaves(
-                ldocs, ones, [wave + 1] * n_leaf_docs, [0] * n_leaf_docs,
-                ["root"] * n_leaf_docs, ["kids"] * n_leaf_docs,
-                [lid(i, wave) for i in range(n_leaf_docs)],
-                [wave] * n_leaf_docs,
-                afters=[lid(i, wave - 1) for i in range(n_leaf_docs)])
-            assert res["nacked"] == 0
+        tickets = [ex.submit(None, leaf_ones, leaf_cseqs(w + 1),
+                             leaf_zero, b, rows=lrows)
+                   for w, b in enumerate(lbs[1:])]
+        ex.drain()
         _ = eng.sync()
-        rate = n_leaf_docs * n_leaf_waves / (time.perf_counter() - t0)
+        rate = n_leaf_waves * leaf_n_ops / (time.perf_counter() - t0)
+        for tk in tickets:
+            assert tk.result()["nacked"] == 0
+        ex.close()
         return eng, rate
 
     leaf_trials = []
@@ -787,9 +833,14 @@ def run():
     kbatch = tree_batches(fresh_tree_engine())[1]
     krec = kbatch["recs"]
     krec_op = kbatch["rec_op"]
-    # the SAME packing the serving dispatch uses (one shared layout)
+    # the SAME packing the serving dispatch uses (one shared layout,
+    # id/value lanes width-coded u16 → u32 when a table outgrows u16 —
+    # the old unconditional u16 silently truncated this wave's ~74k-id
+    # table, wrapping indices instead of exercising the real layout)
     kcols, kids, kvals, krow, kposb, ko = pack_wire_records(
-        krec, krec_op, kr[krec_op])
+        krec, krec_op, kr[krec_op],
+        id_t=np.uint16 if len(kbatch["ids"]) < 0xFFFF else np.uint32,
+        val_t=np.uint16 if len(kbatch["values"]) < 0xFFFF else np.uint32)
     kbase = np.full(n_tree_docs, 2, np.int32)
     kmaps = [np.pad(np.asarray(
         [e if isinstance(e, int) else 1 for e in kbatch["ids"]],
@@ -1005,14 +1056,18 @@ def run():
                     vals.append(int(srng.integers(0, 1 << 20)))
             return ids, cseqs, rp, cp, vals
 
-        ids, cseqs, rp, cp, vals = storm()   # warmup (compiles the scan)
+        # storms pre-generated OUTSIDE the timed section: the rng loop
+        # is the simulated clients' op authoring, not serving work —
+        # the same treatment the string/tree phases give their
+        # pre-encoded waves (client serialization happens client-side)
+        waves = [storm() for _w in range(7)]
+        ids, cseqs, rp, cp, vals = waves[0]  # warmup (compiles the scan)
         eng.ingest_cells(ids, [7] * len(ids), cseqs, [0] * len(ids),
                          rp, cp, vals)
         _ = eng.dims(mdocs[0])
         n_serve = 0
         t0 = time.perf_counter()
-        for _w in range(6):
-            ids, cseqs, rp, cp, vals = storm()
+        for ids, cseqs, rp, cp, vals in waves[1:]:
             res = eng.ingest_cells(ids, [7] * len(ids), cseqs,
                                    [0] * len(ids), rp, cp, vals)
             assert res["nacked"] == 0
@@ -1135,15 +1190,92 @@ def run():
             assert res["nacked"] == 0
         samples = samples[1:]   # first sample compiles the OW shape
         samples.sort()
+        snap = se.metrics.snapshot()
         small_window_ack[str(nd)] = {
             "p50_ms": round(samples[len(samples) // 2] * 1000, 2),
             "p99_ms": round(samples[-1] * 1000, 2),
+            # WHERE the ack wall goes (stage p50s over this window
+            # size's samples): C++ sequencing vs host plane prep/pack
+            # vs the async device dispatch vs the durable append — the
+            # split that shows whether a regression is sequencer, host
+            # packing, or log I/O before anyone stares at a profiler
+            "split_p50_ms": {
+                k.replace("ingest_", "").replace("_ms", ""):
+                    round(snap.get(f"{k}_p50_ms", 0), 3)
+                for k in ("ingest_seq_ms", "ingest_prep_ms",
+                          "ingest_pack_ms", "ingest_dispatch_ms",
+                          "ingest_log_ms")},
+            # the same p50 wall as a per-op budget across the window
+            "per_op_us": round(
+                samples[len(samples) // 2] * 1e6 / (nd * OW), 2),
         }
         del se
     small_window_ack["budget"] = {
         "device_reads": 0, "device_round_trips": 0,
         "note": "ack = C++ sequencing + durable append + async device "
                 "dispatch; floor is host time, no link RTT in the path"}
+
+    # genuinely CONCURRENT two-submitter variant: the loops above
+    # measure an UNCONTENDED ack (one thread, engine idle between
+    # windows); production front doors race. Two submitter threads
+    # share the 256-doc engine behind one lock (the ingest path is
+    # single-writer by design — the lock IS the sequencer front door);
+    # each sample is submit-intent → ack wall, so time queued behind
+    # the other submitter's window is counted in the percentile.
+    se2 = StringServingEngine(n_docs=256, capacity=256,
+                              batch_window=10 ** 9,
+                              compact_every=10 ** 9, sequencer="native")
+    s2docs = [f"sw2-{i}" for i in range(256)]
+    for d in s2docs:
+        se2.connect(d, 1)
+        se2.connect(d, 2)
+    s2rows = np.array([se2.doc_row(d) for d in s2docs], np.int32)
+    OW = 8
+    ins_kind = np.full((256, OW), int(OpKind.STR_INSERT), np.int32)
+    zeros_p = np.zeros((256, OW), np.int32)
+    se2.ingest_planes(  # warmup: compiles this engine's window shape
+        s2rows, np.ones((256, OW), np.int32),
+        np.broadcast_to(np.arange(1, OW + 1, dtype=np.int32), (256, OW)),
+        zeros_p, ins_kind, zeros_p, zeros_p, "abcd")
+    front_door = threading.Lock()
+    conc_walls: list = []
+    conc_lock = threading.Lock()
+    conc_start = threading.Barrier(2)
+    N_WIN2 = 12
+
+    def _submitter(cid, cseq_base):
+        cl_pl = np.full((256, OW), cid, np.int32)
+        for c in range(N_WIN2):
+            cseq = np.broadcast_to(
+                np.arange(cseq_base + c * OW + 1,
+                          cseq_base + c * OW + OW + 1,
+                          dtype=np.int32), (256, OW))
+            if c == 0:
+                conc_start.wait()
+            tb = time.perf_counter()
+            with front_door:
+                res = se2.ingest_planes(s2rows, cl_pl, cseq, zeros_p,
+                                        ins_kind, zeros_p, zeros_p,
+                                        "abcd")
+            dt = time.perf_counter() - tb
+            assert res["nacked"] == 0
+            with conc_lock:
+                conc_walls.append(dt)
+
+    _subs = [threading.Thread(target=_submitter, args=(1, OW)),
+             threading.Thread(target=_submitter, args=(2, 0))]
+    for _t2 in _subs:
+        _t2.start()
+    for _t2 in _subs:
+        _t2.join()
+    conc_walls.sort()
+    small_window_ack["256_two_submitters"] = {
+        "p50_ms": round(conc_walls[len(conc_walls) // 2] * 1000, 2),
+        "p99_ms": round(conc_walls[-1] * 1000, 2),
+        "windows": len(conc_walls),
+        "note": "two front-door threads racing one engine lock; each "
+                "wall includes queueing behind the other submitter"}
+    del se2
 
     _phase("ack latency")
     # --- ingest→ack latency distribution ------------------------------------
@@ -1354,16 +1486,19 @@ def run():
                           "ingest_pack_ms", "ingest_prepack_ms",
                           "ingest_dispatch_ms", "ingest_log_ms")}
             for eng_name, e in (("broadcast", engine),
-                                ("rich", rich_engine))},
+                                ("rich", rich_engine),
+                                ("tree", tree_eng))},
         "ingest_wave_wall_p50_ms": {
             eng_name: round(e.metrics.snapshot().get(
                 "ingest_wave_wall_ms_p50_ms", 0), 1)
             for eng_name, e in (("broadcast", engine),
-                                ("rich", rich_engine))},
+                                ("rich", rich_engine),
+                                ("tree", tree_eng))},
         # executor occupancy/overlap from each phase's best trial
         # (overlap > 1.0 == stages genuinely ran concurrently)
         "ingest_pipeline": {"broadcast": serving_pipe_stats,
-                            "rich": rich_pipe_stats},
+                            "rich": rich_pipe_stats,
+                            "tree": tree_pipe_stats},
         "matrix_serving_ops_per_sec": round(matrix_serving_ops_per_sec, 1),
         "matrix_serving_ops_per_sec_median":
             round(matrix_trials[len(matrix_trials) // 2], 1),

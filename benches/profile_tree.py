@@ -1,12 +1,18 @@
 """Profile the tree serving paths post-redesign: dict ingest_batch,
-pre-encoded ingest_records, flat ingest_leaves, kernel-only."""
+pre-encoded ingest_records (serial and pipelined), the unified flat
+path (pre-encoded leaf records through the SAME ingest_records
+pipeline), kernel-only."""
 import time
 
 import numpy as np
 import jax
 
 from fluidframework_tpu.server.serving import TreeServingEngine
-from fluidframework_tpu.server.tree_wire import encode_tree_batch
+from fluidframework_tpu.server.tree_wire import (encode_leaf_records,
+                                                 encode_tree_batch)
+from fluidframework_tpu.server.ingest_pipeline import (
+    PipelinedIngestExecutor,
+)
 from fluidframework_tpu.ops.tree_kernel import TreeState
 
 n_docs = 8192
@@ -79,20 +85,27 @@ snap = eng.metrics.snapshot()
 print({k: round(v, 1) for k, v in snap.items() if "ingest_" in k and
        "p50" in k})
 
-# pipelined: 4 pre-encoded waves, one sync
+# pipelined: 4 pre-encoded waves through the staged executor (wave N+1
+# prepacks/sequences under wave N's dispatch)
 batches = []
 for w in range(4, 8):
     ids, ops = tree_ops(w)
     batches.append(encode_tree_batch(ops))
+ex = PipelinedIngestExecutor(eng, depth=3)
 t0 = time.perf_counter()
 for w, b in enumerate(batches):
-    eng.ingest_records(ids, ones, [w + 5] * n_docs, [0] * n_docs, b)
+    ex.submit(ids, ones, [w + 5] * n_docs, [0] * n_docs, b)
+ex.drain()
 _ = np.asarray(eng.store.state.node_id)
 t_pipe = time.perf_counter() - t0
 print(f"4 record waves pipelined: {t_pipe*1000:.1f}ms -> "
-      f"{4*n_docs/t_pipe:.0f} ops/s")
+      f"{4*n_docs/t_pipe:.0f} ops/s overlap="
+      f"{ex.stats()['overlap']:.2f}")
+ex.close()
 
-# flat leaves path
+# flat path: pre-encoded leaf records through the SAME ingest_records
+# pipeline (ingest_leaves is now a thin validated builder over this —
+# hot callers pre-encode off the serving thread, as here)
 n_leaf = 8192
 leng = TreeServingEngine(n_docs=n_leaf, capacity=128,
                          batch_window=10 ** 9, sequencer="native")
@@ -104,15 +117,23 @@ leng.ingest_leaves(ldocs, lones, lones, [0] * n_leaf, ["root"] * n_leaf,
                    ["kids"] * n_leaf, [f"{d}-f0" for d in ldocs],
                    [0] * n_leaf)
 _ = np.asarray(leng.store.state.node_id)
+lrows = np.array([leng.doc_row(d) for d in ldocs], np.int32)
+flat_batches = [
+    encode_leaf_records(["root"] * n_leaf, ["kids"] * n_leaf,
+                        [f"{d}-f{wave}" for d in ldocs],
+                        [wave] * n_leaf, None,
+                        [f"{d}-f{wave-1}" for d in ldocs])
+    for wave in range(1, 5)]
+lex = PipelinedIngestExecutor(leng, depth=3)
 t0 = time.perf_counter()
-for wave in range(1, 5):
-    leng.ingest_leaves(ldocs, lones, [wave + 1] * n_leaf, [0] * n_leaf,
-                       ["root"] * n_leaf, ["kids"] * n_leaf,
-                       [f"{d}-f{wave}" for d in ldocs], [wave] * n_leaf,
-                       afters=[f"{d}-f{wave-1}" for d in ldocs])
+for wave, b in enumerate(flat_batches, start=1):
+    lex.submit(None, lones, [wave + 1] * n_leaf, [0] * n_leaf, b,
+               rows=lrows)
+lex.drain()
 _ = np.asarray(leng.store.state.node_id)
 t_flat = time.perf_counter() - t0
 print(f"4 flat waves: {t_flat*1000:.1f}ms -> {4*n_leaf/t_flat:.0f} ops/s")
+lex.close()
 
 # kernel-only: pre-packed planes, pipelined applies
 ids, ops = tree_ops(9)

@@ -156,6 +156,7 @@ class TensorAxisStore:
             self.state = shard_axis_store_state(self.state, mesh)
         self._runs: List[Tuple[int, int]] = [(0, 0)]  # run 0 reserved
         self._run_ids: Dict[Tuple[int, int], int] = {}
+        self._runs_np = None  # cached columnar view of _runs
         self._client_idx: List[Dict[int, int]] = [
             dict() for _ in range(2 * n_docs)]
 
@@ -169,6 +170,17 @@ class TensorAxisStore:
     def run_key(self, handle: int, off: int) -> Tuple[int, int]:
         mixed, base = self._runs[handle]
         return (mixed, base + off)
+
+    def runs_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The run table as (mixed, base) int64 columns — re-materialized
+        only when the table has grown, so a whole resolved-key stream
+        turns into two gathers instead of per-op ``run_key`` calls."""
+        cache = self._runs_np
+        if cache is None or len(cache[0]) != len(self._runs):
+            arr = np.asarray(self._runs, np.int64).reshape(-1, 2)
+            cache = self._runs_np = (np.ascontiguousarray(arr[:, 0]),
+                                     np.ascontiguousarray(arr[:, 1]))
+        return cache
 
     def client(self, axis_row: int, client_id: int) -> int:
         m = self._client_idx[axis_row]
@@ -349,5 +361,6 @@ class TensorAxisStore:
             store.state = shard_axis_store_state(store.state, mesh)
         store._runs = [tuple(r) for r in snap["runs"]]
         store._run_ids = {r: i for i, r in enumerate(store._runs) if i}
+        store._runs_np = None
         store._client_idx = [dict(m) for m in snap["client_idx"]]
         return store

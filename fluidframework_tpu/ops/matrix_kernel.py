@@ -99,6 +99,72 @@ apply_cells_batch_jit = jax.jit(apply_cells_batch, donate_argnums=0,
                                 static_argnums=4)
 
 
+def apply_cells_prefix(state: MatrixCellState, op_key, op_seq, op_value,
+                       L: int, fww=False) -> MatrixCellState:
+    """Capacity-independent merge: live entries occupy a key-sorted
+    prefix bounded by the interned-identity count (keys are dense
+    interned ids), so only ``table[:L]`` participates — the host picks L
+    as the next pow2 ≥ the identity count (jit retraces only on pow2
+    growth); rows past L are EMPTY_KEY by the sorted invariant and pass
+    through untouched.
+
+    Unlike the full-table kernel this never re-sorts the table: the
+    prefix is ALREADY key-sorted, so only the (O,) batch is sorted and
+    the two streams meet in a rank merge — merge positions come from two
+    ``searchsorted`` passes and every data movement is a gather (XLA
+    scatters and wide multi-operand sorts are the slow primitives on
+    both CPU and TPU backends; see the module docstring). Equal keys
+    tie-break table-before-batch, which is exact: sequenced batch seqs
+    are strictly newer than any stored seq."""
+    T = state.key.shape[0]
+    tk, ts, tv = state.key[:L], state.seq[:L], state.value[:L]
+    ok, osq, ov = jax.lax.sort([op_key, op_seq, op_value], num_keys=2,
+                               is_stable=False)
+    O = ok.shape[0]
+    N = L + O
+    # merged position of batch element j: j + (# table keys ≤ its key)
+    pos_b = jnp.arange(O, dtype=jnp.int32) + jnp.searchsorted(
+        tk, ok, side="right").astype(jnp.int32)
+    # invert the merge by counting: at merged position p there are
+    # b_cnt batch elements in [0, p]; p is a batch slot iff pos_b
+    # lands on it, else it takes table element p - b_cnt
+    p_arr = jnp.arange(N, dtype=jnp.int32)
+    b_cnt = jnp.searchsorted(pos_b, p_arr, side="right").astype(jnp.int32)
+    jb = jnp.maximum(b_cnt - 1, 0)
+    is_b = (b_cnt > 0) & (pos_b[jb] == p_arr)
+    ja = jnp.minimum(p_arr - b_cnt, L - 1)
+    mk = jnp.where(is_b, ok[jb], tk[ja])
+    ms = jnp.where(is_b, osq[jb], ts[ja])
+    mv = jnp.where(is_b, ov[jb], tv[ja])
+    nxt_same = jnp.concatenate(
+        [mk[1:] == mk[:-1], jnp.zeros((1,), bool)])
+    prv_same = jnp.concatenate(
+        [jnp.zeros((1,), bool), mk[1:] == mk[:-1]])
+    win = jnp.where(fww, ~prv_same, ~nxt_same) & (mk != EMPTY_KEY)
+    # winner compaction, also by gather: output slot q holds the q-th
+    # winner — the first merged position with cumulative win count q+1
+    c = jnp.cumsum(win.astype(jnp.int32))
+    live = c[-1]
+    wq = jnp.searchsorted(
+        c, jnp.arange(1, L + 1, dtype=jnp.int32), side="left")
+    wq = jnp.minimum(wq, N - 1)
+    keep = jnp.arange(L, dtype=jnp.int32) < live
+    return MatrixCellState(
+        key=jnp.concatenate(
+            [jnp.where(keep, mk[wq], EMPTY_KEY), state.key[L:]]),
+        seq=jnp.concatenate(
+            [jnp.where(keep, ms[wq], 0), state.seq[L:]]),
+        value=jnp.concatenate(
+            [jnp.where(keep, mv[wq], 0), state.value[L:]]),
+        count=jnp.minimum(live, T),
+        overflow=jnp.where(live > L, 1, state.overflow),
+    )
+
+
+apply_cells_prefix_jit = jax.jit(apply_cells_prefix, donate_argnums=0,
+                                 static_argnums=(4, 5))
+
+
 def matrix_cells_digest(state: MatrixCellState) -> jax.Array:
     """Order-invariant digest of the live cell set for cross-replica checks
     (the race-detection analog, SURVEY.md §5.2)."""
@@ -106,6 +172,18 @@ def matrix_cells_digest(state: MatrixCellState) -> jax.Array:
     mix = state.key * jnp.int32(1000003) + state.value * jnp.int32(8191) \
         + state.seq
     return jnp.sum(jnp.where(live, mix, 0)) + state.count
+
+
+def _intern_values_column(interner: ValueInterner, values) -> np.ndarray:
+    """Value handles for a whole cell column. Homogeneous-int columns (the
+    volume case) intern one handle per UNIQUE value and gather; the type
+    probe is exact (``bool`` is excluded — ``True`` and ``1`` canonicalize
+    to different JSON) so the general path keeps full fidelity."""
+    if set(map(type, values)) == {int}:
+        u, inv = np.unique(np.asarray(values, np.int64),
+                           return_inverse=True)
+        return np.asarray(interner.bulk_ints(u.tolist()), np.int32)[inv]
+    return np.asarray(interner.bulk(values), np.int32)
 
 
 class TensorMatrixStore:
@@ -143,6 +221,23 @@ class TensorMatrixStore:
         """One-way LWW → FWW switch (reference ``switchSetCellPolicy``)."""
         self.fww = True
 
+    def _merge_chunk(self, key, seq, val) -> None:
+        """One padded-chunk merge dispatch, prefix-sized when the table
+        is mostly free: live ≤ interned identities, so a pow2 prefix
+        bound keeps the sort cost proportional to the LIVE table."""
+        L = 8
+        need = min(len(self._cell_ids) + 1, self.capacity)
+        while L < need:
+            L *= 2
+        if L >= self.capacity:
+            self.state = apply_cells_batch_jit(
+                self.state, jnp.asarray(key), jnp.asarray(seq),
+                jnp.asarray(val), self.fww)
+        else:
+            self.state = apply_cells_prefix_jit(
+                self.state, jnp.asarray(key), jnp.asarray(seq),
+                jnp.asarray(val), L, self.fww)
+
     def apply_batch(self, records) -> None:
         """records: iterable of (row_key, col_key, value, seq), seq ascending."""
         recs = [(self.cell_id(r, c), int(s), self.value_handle(v))
@@ -160,9 +255,42 @@ class TensorMatrixStore:
                 key = np.concatenate([key, np.full(pad, EMPTY_KEY)])
                 seq = np.concatenate([seq, np.zeros(pad, np.int32)])
                 val = np.concatenate([val, np.zeros(pad, np.int32)])
-            self.state = apply_cells_batch_jit(
-                self.state, jnp.asarray(key), jnp.asarray(seq),
-                jnp.asarray(val), self.fww)
+            self._merge_chunk(key, seq, val)
+
+    def apply_batch_columnar(self, row_keys, col_keys, values,
+                             seqs) -> None:
+        """Columnar twin of ``apply_batch``: prebuilt key-tuple columns +
+        a value column + an int seq array. One tight bulk pass per intern
+        table and array-sliced chunk packing — no per-record tuple churn
+        or ``fromiter`` scans (the matrix serving hot path)."""
+        n = len(row_keys)
+        if not n:
+            return
+        ids = self._cell_ids
+        get = ids.get
+        key = np.empty(n, np.int32)
+        i = 0
+        for rk, ck in zip(row_keys, col_keys):
+            k = (rk, ck)
+            h = get(k)
+            if h is None:
+                h = len(ids)
+                ids[k] = h
+            key[i] = h
+            i += 1
+        val = _intern_values_column(self._interner, values)
+        seqs = np.ascontiguousarray(seqs, np.int32)
+        for i in range(0, n, self.batch):
+            kc = key[i:i + self.batch]
+            sc = seqs[i:i + self.batch]
+            vc = val[i:i + self.batch]
+            pad = self.batch - len(kc)
+            if pad:
+                kc = np.concatenate([kc, np.full(pad, EMPTY_KEY,
+                                                 np.int32)])
+                sc = np.concatenate([sc, np.zeros(pad, np.int32)])
+                vc = np.concatenate([vc, np.zeros(pad, np.int32)])
+            self._merge_chunk(kc, sc, vc)
 
     def read_cell(self, cell: Tuple):
         """One cell's value without the full-table readback: the table is
@@ -369,6 +497,58 @@ class ShardedMatrixStore:
             self.state = sharded_cells_apply(self.mesh, self.fww)(
                 self.state, jnp.asarray(key), jnp.asarray(seq),
                 jnp.asarray(val))
+
+    def apply_batch_columnar(self, row_keys, col_keys, values,
+                             seqs) -> None:
+        """Columnar twin of ``apply_batch`` with the same doc-row shard
+        routing (``row_key[0]``); stable per-shard partition keeps each
+        shard's stream seq-ascending."""
+        n = len(row_keys)
+        if not n:
+            return
+        ids = self._cell_ids
+        get = ids.get
+        counts = self._shard_counts
+        ns, nd = self.n_shards, self.n_docs
+        key = np.empty(n, np.int32)
+        shard = np.empty(n, np.int32)
+        i = 0
+        for rk, ck in zip(row_keys, col_keys):
+            k = (rk, ck)
+            s = rk[0] * ns // nd
+            h = get(k)
+            if h is None:
+                h = len(ids)
+                ids[k] = h
+                counts[s] += 1
+            key[i] = h
+            shard[i] = s
+            i += 1
+        val = _intern_values_column(self._interner, values)
+        seqs = np.ascontiguousarray(seqs, np.int32)
+        order = np.argsort(shard, kind="stable")
+        bounds = np.searchsorted(shard[order], np.arange(ns + 1))
+        widest = int(np.diff(bounds).max())
+        from ..parallel.sharded import sharded_cells_apply
+        for base in range(0, widest, self.batch):
+            o = min(self.batch, widest - base)
+            o2 = 8
+            while o2 < o:
+                o2 *= 2
+            keyp = np.full((ns, o2), EMPTY_KEY, np.int32)
+            seqp = np.zeros((ns, o2), np.int32)
+            valp = np.zeros((ns, o2), np.int32)
+            for s in range(ns):
+                idx = order[bounds[s]:bounds[s + 1]][
+                    base:base + self.batch]
+                if not len(idx):
+                    continue
+                keyp[s, :len(idx)] = key[idx]
+                seqp[s, :len(idx)] = seqs[idx]
+                valp[s, :len(idx)] = val[idx]
+            self.state = sharded_cells_apply(self.mesh, self.fww)(
+                self.state, jnp.asarray(keyp), jnp.asarray(seqp),
+                jnp.asarray(valp))
 
     def read_cell(self, cell: Tuple):
         cid = self._cell_ids.get(cell)

@@ -171,6 +171,26 @@ class ValueInterner:
             append(h)
         return out
 
+    def bulk_ints(self, items) -> list:
+        """``bulk`` fast lane for homogeneous Python-int columns: the
+        canonical JSON of an int IS ``repr(int)``, so the dumps machinery
+        drops out (callers must exclude ``bool`` — ``True`` and ``1``
+        canonicalize differently)."""
+        ids = self._ids
+        values = self._values
+        get = ids.get
+        out = []
+        append = out.append
+        for v in items:
+            enc = repr(v)
+            h = get(enc)
+            if h is None:
+                h = len(values)
+                ids[enc] = h
+                values.append(v)
+            append(h)
+        return out
+
     def value(self, handle: int):
         return self._values[handle]
 

@@ -386,8 +386,9 @@ def apply_tree_wire(state: TreeState, cols, ids, vals, row, pos, base,
 
     - ``cols`` (R, 3) u8: kind | meta<<4 (meta bit 0 = nested, bit 1 =
       first-record-of-op), field_local, type_local
-    - ``ids`` (R, 3) u16: node/parent/after batch-local 1-based indices
-    - ``vals`` (R,) u16: value batch-local index
+    - ``ids`` (R, 3) u16/u32: node/parent/after batch-local 1-based
+      indices (u32 when the batch id table outgrows u16)
+    - ``vals`` (R,) u16/u32: value batch-local index
     - ``row`` (R,) u16 / ``pos`` (R,) u8 or u16: dense scatter
       coordinates; ``pos == o`` (out of range) drops the record (R is
       pow2-padded)

@@ -8,8 +8,9 @@ This executor runs the SAME stage methods (serving.py) on three worker
 threads so the recorded stage sum becomes a max:
 
 - **pack worker** — ``_ingest_prepare(prepack=True)``: validation + the
-  interner/table build (``ops/string_store.prepack_planes``), FIFO, for
-  wave N+1 while wave N is on the device;
+  interner/table build (``ops/string_store.prepack_planes`` for string
+  waves, ``ops/tree_store.prepack_wire`` for tree record waves), FIFO,
+  for wave N+1 while wave N is on the device;
 - **seq/dispatch worker** — ``_ingest_sequence`` + ``_ingest_dispatch``:
   the native C++ sequencing call and the async device merge share one
   thread (they share the sequencer and the compaction cursors); the
@@ -111,9 +112,11 @@ class IngestTicket:
 
 
 class PipelinedIngestExecutor:
-    """Bounded-depth staged pipeline over a StringServingEngine's
-    columnar-ingest stage methods. One executor per engine; the serial
-    ``ingest_planes`` stays available for callers that want the
+    """Bounded-depth staged pipeline over an engine's columnar-ingest
+    stage methods (StringServingEngine's plane waves and
+    TreeServingEngine's record waves both speak the protocol). One
+    executor per engine; the serial front doors (``ingest_planes`` /
+    ``ingest_records``) stay available for callers that want the
     round-trip (do not interleave the two mid-flight — drain first)."""
 
     def __init__(self, engine, depth: int = 2):
@@ -155,12 +158,17 @@ class PipelinedIngestExecutor:
 
     # ------------------------------------------------------------ public
 
-    def submit(self, rows, client, client_seq, ref_seq, kind, a0, a1,
-               text: str = "", texts=None, tidx=None,
-               props=None) -> IngestTicket:
+    def submit(self, *args: Any, **kwargs: Any) -> IngestTicket:
         """Enqueue one wave; blocks while ``depth`` waves are in flight
         (backpressure). Returns immediately otherwise — await the ticket
-        (or its callback) for the ack-safe result."""
+        (or its callback) for the ack-safe result.
+
+        Arguments are handed verbatim to the engine's
+        ``_ingest_prepare`` (plus ``prepack=True``): the string engine
+        takes its plane wave (``rows, client, client_seq, ref_seq, kind,
+        a0, a1, ...``), the tree engine its record wave (``doc_ids,
+        clients, client_seqs, ref_seqs, batch, rows=...``) — the
+        executor is signature-agnostic across the staged engines."""
         if self._closed:
             raise RuntimeError("pipelined ingest executor is closed")
         if self._failure is not None:
@@ -179,10 +187,7 @@ class PipelinedIngestExecutor:
             self._waves += 1
             self._inflight += 1
             self._max_inflight = max(self._max_inflight, self._inflight)
-        self._pack_q.put((ticket, dict(
-            rows=rows, client=client, client_seq=client_seq,
-            ref_seq=ref_seq, kind=kind, a0=a0, a1=a1, text=text,
-            texts=texts, tidx=tidx, props=props)))
+        self._pack_q.put((ticket, args, kwargs))
         return ticket
 
     def drain(self, timeout: Optional[float] = None) -> None:
@@ -276,13 +281,13 @@ class PipelinedIngestExecutor:
             if item is _STOP:
                 self._seq_q.put(_STOP)
                 return
-            ticket, kwargs = item
+            ticket, args, kwargs = item
             if self._skip(ticket):
                 self._finish(ticket, error=self._chain_error(ticket))
                 continue
             t0 = time.perf_counter()
             try:
-                wave = eng._ingest_prepare(prepack=True, **kwargs)
+                wave = eng._ingest_prepare(*args, prepack=True, **kwargs)
             except BaseException as e:  # noqa: BLE001 — fail-stop
                 self._fail(ticket, e)
                 continue
@@ -290,9 +295,11 @@ class PipelinedIngestExecutor:
             ticket.wave = wave
             self._seq_q.put(ticket)
             if wave.prepacked is None:
-                # interval wave: its anchor handles mint inside the
-                # dispatch stage; packing the NEXT wave's payload tables
-                # first would allocate handles out of submission order —
+                # un-prepackable wave (interval batch: anchor handles
+                # mint post-nack; tree dense fallback: table handles
+                # mint inline) — its interner writes happen inside the
+                # dispatch stage, so packing the NEXT wave's tables
+                # first would allocate handles out of submission order:
                 # barrier until this wave's dispatch completes.
                 ticket._dispatched.wait()
 

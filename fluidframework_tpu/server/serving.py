@@ -193,21 +193,21 @@ class TreeRecordOps:
 
     def expand(self, only_doc: Optional[str] = None):
         """Per-op messages with DECODED dict contents (oracle replay /
-        audit; the recovery state path uses the raw planes instead)."""
-        from .tree_wire import decode_op
+        audit; the recovery state path uses the raw planes instead).
+        Decode is one vectorized table-gather pass over the whole run
+        (``tree_wire.decode_records``), not a per-record Python loop."""
+        from .tree_wire import decode_records
         idxs = range(len(self.seq))
         if only_doc is not None:
             if only_doc not in self.doc_ids:
                 return []
             want = self.doc_ids.index(only_doc)
             idxs = np.flatnonzero(np.asarray(self.doc) == want)
-        starts, ends = self._op_slices()
+        ops = decode_records(self.rec_op, self.recs, self.ids,
+                             self.fields, self.types, self.values)
         out = []
         for i in idxs:
-            recs = [tuple(int(v) for v in r)
-                    for r in self.recs[starts[i]:ends[i]]]
-            contents = decode_op(recs, self.ids, self.fields, self.types,
-                                 self.values)
+            contents = ops[int(i)]
             out.append(SequencedDocumentMessage(
                 doc_id=self.doc_ids[int(self.doc[i])],
                 client_id=int(self.client[i]),
@@ -2222,16 +2222,25 @@ class MatrixServingEngine(ServingEngineBase):
             raise ValueError("negative cell position")
         if self._queue:   # per-op queue first: per-doc seq order holds
             self.flush()  # (also harvests any deferred cell batches)
-        rows = np.fromiter((self.doc_row(d) for d in doc_ids), np.int32,
-                           count=n)
+        rows_l = list(map(self._doc_rows.get, doc_ids))
+        if None in rows_l:  # unseen docs: the minting slow path
+            rows = np.fromiter((self.doc_row(d) for d in doc_ids),
+                               np.int32, count=n)
+        else:
+            rows = np.asarray(rows_l, np.int32)
         if not self.store.conservative_room(
                 n + self._pending_cell_count):
             raise KeyError("cell table capacity exhausted")
         client = np.ascontiguousarray(clients, np.int32)
-        for i in range(n):  # mint axis client slots BEFORE sequencing
-            row = int(rows[i])  # (capacity failure must reject the batch)
-            self.axis_store.client(2 * row, int(client[i]))
-            self.axis_store.client(2 * row + 1, int(client[i]))
+        # mint axis client slots BEFORE sequencing (capacity failure must
+        # reject the batch) — one interner hit per UNIQUE (row, client)
+        for p in np.unique(rows.astype(np.int64) * 4294967296
+                           + (client.astype(np.int64)
+                              & 0xFFFFFFFF)).tolist():
+            row = p >> 32
+            cid = int(np.uint32(p & 0xFFFFFFFF).astype(np.int32))
+            self.axis_store.client(2 * row, cid)
+            self.axis_store.client(2 * row + 1, cid)
         self._fill_row_handles(np.unique(rows), raw)
         t0 = time.perf_counter()
         cseq = np.ascontiguousarray(client_seqs, np.int32)
@@ -2316,8 +2325,9 @@ class MatrixServingEngine(ServingEngineBase):
             np.arange(len(ok), dtype=np.int32),
             np.zeros(len(ok), np.int32),
             text="", timestamp=ts, family="ops", values=contents_tab))
-        for i in ok:
-            self._min_seq[doc_ids[i]] = int(out_min[i])
+        okl = ok.tolist()
+        self._min_seq.update(zip(map(doc_ids.__getitem__, okl),
+                                 out_min[ok].tolist()))
         if pend is not None:
             self._pending_cells.append(pend)
             self._pending_cell_count += len(pend["rows"])
@@ -2348,31 +2358,111 @@ class MatrixServingEngine(ServingEngineBase):
                 self._pending_cells.clear()
                 raise
             axis, pos = pend["axis"], pend["pos"]
-            rh2 = rh[axis, pos]
-            ro2 = ro[axis, pos]
-            records = []
-            run_key = self.axis_store.run_key
-            for j in range(len(pend["rows"])):
-                hr, hc = int(rh2[2 * j]), int(rh2[2 * j + 1])
-                if hr < 0 or hc < 0:
-                    continue  # out of range at perspective: drop
-                row = int(pend["rows"][j])
-                rk = run_key(hr, int(ro2[2 * j]))
-                ck = run_key(hc, int(ro2[2 * j + 1]))
-                self._fww.setdefault(row, False)
-                meta = self._cell_meta.setdefault(row, {})
-                cell = (rk, ck)
-                if self._fww[row]:
-                    sq, writer = meta.get(cell, (0, None))
-                    if sq > int(pend["ref"][j]) and \
-                            writer != int(pend["client"][j]):
-                        continue
-                meta[cell] = (int(pend["seq"][j]),
-                              int(pend["client"][j]))
-                records.append(((row, rk), ck, pend["values"][j],
-                                int(pend["seq"][j])))
-            if records:
-                self.store.apply_batch(records)
+            rh2 = rh[axis, pos].astype(np.int64)
+            ro2 = ro[axis, pos].astype(np.int64)
+            hr, hc = rh2[0::2], rh2[1::2]
+            vi = np.flatnonzero((hr >= 0) & (hc >= 0))
+            if not len(vi):  # out of range at perspective: drop
+                continue
+            # resolved run keys: two gathers over the interned run table
+            # (no per-op run_key() calls)
+            mixed, base = self.axis_store.runs_arrays()
+            hr_v, hc_v = hr[vi], hc[vi]
+            rkm, rkb = mixed[hr_v], base[hr_v] + ro2[0::2][vi]
+            ckm, ckb = mixed[hc_v], base[hc_v] + ro2[1::2][vi]
+            rows_v = pend["rows"][vi]
+            seq_v = pend["seq"][vi]
+            cl_v = pend["client"][vi]
+            keep = self._fww_filter_columnar(
+                rows_v, rkm, rkb, ckm, ckb, seq_v, cl_v,
+                pend["ref"][vi])
+            kept = np.flatnonzero(keep)
+            if not len(kept):
+                continue
+            # key tuples materialized ONCE, for survivors only — these
+            # feed both the visibility metadata and the columnar merge
+            rk_pairs = list(zip(rkm[kept].tolist(), rkb[kept].tolist()))
+            ck_pairs = list(zip(ckm[kept].tolist(), ckb[kept].tolist()))
+            rows_l = rows_v[kept].tolist()
+            seq_l = seq_v[kept].tolist()
+            cl_l = cl_v[kept].tolist()
+            cells = list(zip(rk_pairs, ck_pairs))
+            pairs = list(zip(seq_l, cl_l))
+            # per-doc meta write-back in batch order (dict.update is
+            # last-wins — exactly the retired loop's final state)
+            ri = rows_v[kept]
+            order = np.argsort(ri, kind="stable")
+            ri_sorted = ri[order]
+            urows = np.unique(ri_sorted)
+            bounds = np.searchsorted(ri_sorted, urows)
+            bounds = np.append(bounds, len(ri_sorted))
+            for i, r in enumerate(urows.tolist()):
+                idxs = order[bounds[i]:bounds[i + 1]].tolist()
+                self._cell_meta[r].update(
+                    zip(map(cells.__getitem__, idxs),
+                        map(pairs.__getitem__, idxs)))
+            vals = pend["values"]
+            fi = vi[kept].tolist()
+            self.store.apply_batch_columnar(
+                list(zip(rows_l, rk_pairs)), ck_pairs,
+                list(map(vals.__getitem__, fi)),
+                np.asarray(seq_l, np.int32))
+
+    def _fww_filter_columnar(self, rows, rkm, rkb, ckm, ckb, seqs,
+                             clients, refs) -> np.ndarray:
+        """First-writer-wins pass over one resolved, per-doc
+        seq-ascending key stream — columnar, not op-by-op. Returns the
+        bool keep mask; semantics are identical to the retired per-op
+        loop: an op is dropped when the cell's current meta seq is newer
+        than its ref AND held by a different writer, and each surviving
+        op installs (seq, client) as the new meta (so within-batch writes
+        chain). Cells written once in the batch (the volume case) are
+        judged vectorized against the persistent meta; multiply-written
+        cells replay the exact chain over just their own ops."""
+        k = len(rows)
+        urows, row_inv = np.unique(rows, return_inverse=True)
+        fww_flags = np.empty(len(urows), bool)
+        for i, r in enumerate(urows.tolist()):
+            fww_flags[i] = self._fww.setdefault(r, False)
+            self._cell_meta.setdefault(r, {})
+        keep = np.ones(k, bool)
+        fww_op = fww_flags[row_inv]
+        if not fww_op.any():
+            return keep
+        ident = np.empty((k, 5), np.int64)
+        ident[:, 0] = rows
+        ident[:, 1] = rkm
+        ident[:, 2] = rkb
+        ident[:, 3] = ckm
+        ident[:, 4] = ckb
+        _, first, inv, counts = np.unique(
+            np.ascontiguousarray(ident).view([("", np.int64)] * 5
+                                             ).ravel(),
+            return_index=True, return_inverse=True, return_counts=True)
+        # persistent meta probed ONCE per unique fww cell
+        nu = len(first)
+        prev_seq = np.zeros(nu, np.int64)
+        prev_writer = np.full(nu, -1, np.int64)  # absent → seq 0 passes
+        ufww = np.flatnonzero(fww_op[first])
+        for t in ufww.tolist():
+            j0 = int(first[t])
+            prev = self._cell_meta[int(rows[j0])].get(
+                ((int(rkm[j0]), int(rkb[j0])),
+                 (int(ckm[j0]), int(ckb[j0]))))
+            if prev is not None:
+                prev_seq[t], prev_writer[t] = prev
+        sing = fww_op & (counts[inv] == 1)
+        keep[sing] = ~((prev_seq[inv][sing] > refs[sing])
+                       & (prev_writer[inv][sing] != clients[sing]))
+        for t in np.intersect1d(ufww, np.flatnonzero(counts > 1)
+                                ).tolist():
+            cs, cw = int(prev_seq[t]), int(prev_writer[t])
+            for j in np.flatnonzero(inv == t).tolist():
+                if cs > int(refs[j]) and cw != int(clients[j]):
+                    keep[j] = False
+                else:
+                    cs, cw = int(seqs[j]), int(clients[j])
+        return keep
 
     def _dispatch_axis(self, per_axis: Dict[int, list]):
         """Dense (2·D, O) planes from per-axis op lists → one scan.
@@ -2554,6 +2644,28 @@ class MatrixServingEngine(ServingEngineBase):
         engine._replay_tail(summary)
         engine.flush()
         return engine
+
+
+class _TreeIngestWave:
+    """Per-wave carrier threaded through the tree engine's four
+    columnar-ingest stages (``_ingest_prepare`` → ``_ingest_sequence``
+    → ``_ingest_dispatch`` → ``_ingest_log``) — the tree analog of
+    ``_IngestWave``; the same ``PipelinedIngestExecutor`` hands one of
+    these from worker to worker, the serial ``ingest_records`` walks it
+    in place."""
+    __slots__ = (
+        "t_start", "n", "rows", "uniq_rows", "batch", "rec_op", "recs",
+        "client", "cseq", "ref", "prepacked", "pipelined", "prep_ms",
+        "prepack_ms", "seq_ms", "dispatch_ms", "out_seq", "out_min",
+        "nacked", "n_ok", "keep", "ok")
+
+    def __init__(self):
+        self.prepacked = None
+        self.pipelined = False
+        self.prep_ms = 0.0
+        self.prepack_ms = 0.0
+        self.seq_ms = 0.0
+        self.dispatch_ms = 0.0
 
 
 class TreeServingEngine(ServingEngineBase):
@@ -2810,33 +2922,33 @@ class TreeServingEngine(ServingEngineBase):
         return g
 
     def _wire_eligible(self, batch: dict) -> bool:
-        """Can this batch ride the compact width-coded wire? (Tables must
-        fit the narrow index widths; huge batches — and mesh stores,
-        whose dense planes shard row-wise — take the dense path.)"""
+        """Can this batch ride the compact width-coded wire? Id/value
+        index lanes width-code u16 → u32 (``pack_wire_records``), so
+        only the u8 field/type lanes and the u16 row lane bound table
+        sizes; mesh stores, whose dense planes shard row-wise, take the
+        dense path."""
         return (self.mesh is None
-                and len(batch["ids"]) < 0xFFFF
+                and len(batch["ids"]) < 0x7FFFFFFF
                 and len(batch["fields"]) < 0xFF
                 and len(batch["types"]) < 0xFF
-                and len(batch["values"]) < 0xFFFF
+                and len(batch["values"]) < 0x7FFFFFFF
                 and self.n_docs <= 0x10000)
 
     _WIRE_R_FLOOR = 256   # pow2 record-padding floor (bounds recompiles)
 
     def _dispatch_wire(self, batch, recs, rec_op, keep, rows, out_seq,
                        nacked):
-        """Pack kept records into the width-coded wire buffers and
+        """Pack kept records into pooled width-coded wire buffers and
         dispatch ``apply_tree_wire`` (upload bytes are the bottleneck —
         see tree_kernel). Returns the prep/dispatch split timestamp, or
         None when the dense path must handle the batch (oversized o)."""
-        from ..ops.tree_store import _pow2_at_least, pack_wire_records
         recs_k = recs[keep]
         rec_op_k = rec_op[keep]
         rows_r = rows[rec_op_k].astype(np.int64)
-        packed = pack_wire_records(recs_k, rec_op_k, rows_r,
-                                   r_floor=self._WIRE_R_FLOOR)
-        if packed is None:
+        pp = self.store.prepack_wire(recs_k, rec_op_k, rows_r, batch,
+                                     r_floor=self._WIRE_R_FLOOR)
+        if pp is None:
             return None
-        cols, idsb, valsb, rowb, posb, o = packed
         # per-doc first-op seq (op seqs are consecutive per doc in-batch)
         base = np.zeros(self.n_docs, np.int32)
         ok = np.flatnonzero(~nacked)
@@ -2844,22 +2956,174 @@ class TreeServingEngine(ServingEngineBase):
             rows_ok = rows[ok]
             uniq, firsti = np.unique(rows_ok, return_index=True)
             base[uniq] = out_seq[ok][firsti].astype(np.int32)
-
-        def pad_map(items, interner):
-            m = np.zeros(_pow2_at_least(len(items) + 1, floor=8),
-                         np.int32)
-            if items:
-                m[1:len(items) + 1] = interner.bulk(items)
-            return m
-
-        id_map = pad_map(batch["ids"], self.store._ids)
-        f_map = pad_map(batch["fields"], self.store._fields)
-        t_map = pad_map(batch["types"], self.store._types)
-        v_map = pad_map(batch["values"], self.store._values)
         t_prep = time.perf_counter()
-        self.store.apply_wire(cols, idsb, valsb, rowb, posb, base,
-                              id_map, f_map, t_map, v_map, o)
+        self.store.apply_wire_prepacked(pp, base)
         return t_prep
+
+    def _ingest_prepare(self, doc_ids: Optional[List[str]], clients,
+                        client_seqs, ref_seqs, batch: dict,
+                        rows: Optional[np.ndarray] = None,
+                        prepack: bool = False) -> "_TreeIngestWave":
+        """Stage 1 — validation, row resolution, row-handle fill, and
+        (``prepack=True``, pipelined mode) the pooled wire pack +
+        interner maps, all independent of sequencing results."""
+        raw = getattr(self.deli, "raw", None)
+        if raw is None:
+            raise RuntimeError("batch ingest requires sequencer='native'")
+        w = _TreeIngestWave()
+        w.t_start = time.perf_counter()
+        n = len(doc_ids) if rows is None else len(rows)
+        if not (len(clients) == len(client_seqs) == len(ref_seqs) == n):
+            raise ValueError("batch fields must have equal length")
+        w.rec_op, w.recs = self._validate_record_batch(batch, n)
+        if rows is None:
+            if self._graduated and any(d in self._graduated
+                                       for d in doc_ids):
+                raise ValueError("a targeted doc has graduated off the "
+                                 "flat tier; route its ops through "
+                                 "submit()")
+            rows = np.fromiter((self.doc_row(d) for d in doc_ids),
+                               np.int32, count=n)
+        else:
+            rows = np.ascontiguousarray(rows, np.int32)
+            if n and not ((rows >= 0) & (rows < self.n_docs)).all():
+                raise ValueError("row out of range")
+        w.rows, w.n = rows, n
+        w.uniq_rows = np.unique(rows)
+        # unknown rows fail in _fill_row_handles (no doc → KeyError)
+        self._fill_row_handles(w.uniq_rows, raw)
+        w.batch = batch
+        w.client = np.ascontiguousarray(clients, np.int32)
+        w.cseq = np.ascontiguousarray(client_seqs, np.int32)
+        w.ref = np.ascontiguousarray(ref_seqs, np.int32)
+        w.prep_ms = (time.perf_counter() - w.t_start) * 1000
+        if prepack:
+            w.pipelined = True
+            if self._wire_eligible(batch):
+                t0 = time.perf_counter()
+                # pack EVERY record AHEAD of sequencing (overlaps the
+                # previous wave's dispatch; nacks resolve at dispatch,
+                # which discards the prepack on the rare nacked wave).
+                # None → dense fallback, which mints interner handles
+                # inline: the executor barriers on this wave's dispatch
+                # before packing the next wave's tables.
+                w.prepacked = self.store.prepack_wire(
+                    w.recs, w.rec_op, rows[w.rec_op].astype(np.int64),
+                    batch, r_floor=self._WIRE_R_FLOOR)
+                w.prepack_ms = (time.perf_counter() - t0) * 1000
+        return w
+
+    def _ingest_sequence(self, w: "_TreeIngestWave") -> None:
+        """Stage 2 — per-op queue flush + ONE native sequencing call +
+        nack masking + the per-doc window-floor fold."""
+        self.flush()  # per-op queue first: per-doc seq order must hold
+        t0 = time.perf_counter()
+        raw = self.deli.raw
+        w.out_seq, w.out_min, w.nacked, w.n_ok = self._sequence_columnar(
+            raw, self._row_handle[w.rows], w.client, w.cseq, w.ref,
+            "tree records batch")
+        w.keep = ~w.nacked[w.rec_op] if len(w.rec_op) \
+            else np.zeros(0, bool)
+        w.ok = np.flatnonzero(~w.nacked)
+        if len(w.ok):
+            # per-doc window floor: the LAST op of each doc carries its
+            # latest min_seq (one dict write per doc, not per op)
+            rows_ok = w.rows[w.ok]
+            order = np.argsort(rows_ok, kind="stable")
+            rs = rows_ok[order]
+            ms = w.out_min[w.ok][order]
+            starts = np.r_[0, np.flatnonzero(np.diff(rs)) + 1]
+            lasts = np.r_[starts[1:] - 1, len(rs) - 1]
+            rdi = self._row_doc_id
+            self._min_seq.update(
+                zip((rdi[int(r)] for r in rs[starts]),
+                    (int(m) for m in ms[lasts])))
+        w.seq_ms = (time.perf_counter() - t0) * 1000
+
+    def _ingest_dispatch(self, w: "_TreeIngestWave") -> None:
+        """Stage 3 — the async device merge: the prepacked wire (base
+        derived from this wave's seqs), the inline wire pack, or the
+        dense fallback."""
+        # degradation injection: an armed plan may stall the device
+        # apply here (tunnel RTT spike); the watchdog must surface it
+        fault_point(SITE_APPLY_STALL, what="ingest_records")
+        t0 = time.perf_counter()
+        pp = w.prepacked
+        if pp is not None and w.nacked.any():
+            # rare: the prepack packed EVERY record; drop it and repack
+            # inline below with the keep mask
+            self.store.release_wire(pp)
+            pp = w.prepacked = None
+        t_prep = None
+        if pp is not None:
+            # no nacks: per-doc first-op seq straight off the full rows
+            # (op seqs are consecutive per doc in-batch)
+            base = np.zeros(self.n_docs, np.int32)
+            if len(w.ok):
+                uniq, firsti = np.unique(w.rows, return_index=True)
+                base[uniq] = w.out_seq[firsti].astype(np.int32)
+            t_prep = time.perf_counter()
+            self.store.apply_wire_prepacked(pp, base)
+            w.prepacked = None
+        elif self._wire_eligible(w.batch):
+            t_prep = self._dispatch_wire(w.batch, w.recs, w.rec_op,
+                                         w.keep, w.rows, w.out_seq,
+                                         w.nacked)
+        if t_prep is None:
+            # dense fallback: host-side table mapping + int32 planes
+            g = self._map_records(w.recs, w.batch)
+            rows_r = w.rows[w.rec_op][w.keep]
+            g_k = g[w.keep]
+            seq_r = w.out_seq[w.rec_op][w.keep]
+            t_prep = time.perf_counter()
+            # device apply dispatched before the log append (host log
+            # work rides under it), exactly the string pipeline's order
+            self.store.apply_records(rows_r, g_k, seq_r)
+        w.prep_ms += (t_prep - t0) * 1000
+        w.dispatch_ms = (time.perf_counter() - t_prep) * 1000
+
+    def _ingest_log(self, w: "_TreeIngestWave") -> dict:
+        """Stage 4 — the durable whole-batch append (ack barrier: poison
+        clears and callers may ack only after this commits) + metrics."""
+        t0 = time.perf_counter()
+        ok = w.ok
+        ts = self.deli.clock()
+        doc_tab = [self._row_doc_id[int(r)] for r in w.uniq_rows]
+        doc_plane = np.searchsorted(w.uniq_rows,
+                                    w.rows[ok]).astype(np.int32)
+        new_idx = np.cumsum(~w.nacked) - 1   # op index among kept ops
+        ref_clamped = self._clamped_ref(w.ref, w.out_seq)
+        batch = w.batch
+        self._append_columnar(TreeRecordOps(
+            doc_tab, doc_plane,
+            w.client[ok], w.cseq[ok], ref_clamped[ok], w.out_seq[ok],
+            w.out_min[ok], new_idx[w.rec_op][w.keep],
+            np.ascontiguousarray(w.recs[w.keep]),
+            list(batch["ids"]), list(batch["fields"]),
+            list(batch["types"]), list(batch["values"]), timestamp=ts))
+        log_ms = (time.perf_counter() - t0) * 1000
+        self.metrics.inc("flushes")
+        self.metrics.inc("ops_flushed", w.n_ok)
+        self.metrics.observe("ingest_seq_ms", w.seq_ms)
+        self.metrics.observe("ingest_prep_ms", w.prep_ms)
+        self.metrics.observe("ingest_dispatch_ms", w.dispatch_ms)
+        self.metrics.observe("ingest_log_ms", log_ms)
+        if w.prepack_ms:
+            # pack work that ran OFF the critical path (pack worker,
+            # overlapped with the previous wave's dispatch)
+            self.metrics.observe("ingest_prepack_ms", w.prepack_ms)
+        busy_ms = w.seq_ms + w.prep_ms + w.dispatch_ms + log_ms
+        # pipelined waves sit in stage queues between workers; wall time
+        # since submission would count that waiting as a stall, so the
+        # recorded wave cost is the BUSY time instead
+        elapsed_ms = busy_ms if w.pipelined \
+            else (time.perf_counter() - w.t_start) * 1000
+        self.metrics.observe("flush_ms", elapsed_ms)
+        tracing.TRACER.record_complete(
+            "serving.ingest_records", elapsed_ms, ops=int(w.n_ok),
+            nacked=int(w.nacked.sum()), seq_ms=w.seq_ms,
+            dispatch_ms=w.dispatch_ms, log_ms=log_ms)
+        return {"seq": w.out_seq, "nacked": int(w.nacked.sum())}
 
     def ingest_records(self, doc_ids: Optional[List[str]], clients,
                        client_seqs, ref_seqs, batch: dict,
@@ -2874,99 +3138,19 @@ class TreeServingEngine(ServingEngineBase):
         ``rows`` (from ``doc_row``) instead of ``doc_ids``; cached rows
         are invalidated when ``recover_overflowed`` graduates a doc
         (re-resolve after recovery, as with the string engine). Returns
-        {"seq": (N,) (negative = nack code), "nacked"}."""
+        {"seq": (N,) (negative = nack code), "nacked"}.
+
+        This is the serial walk of the four stage methods above; the
+        ``PipelinedIngestExecutor`` runs the SAME stages on its worker
+        threads (``ex.submit(None, clients, client_seqs, ref_seqs,
+        batch, rows=rows)``), overlapping wire-pack, sequencing, device
+        dispatch, and the durable append across waves."""
         self._check_poisoned()
-        raw = getattr(self.deli, "raw", None)
-        if raw is None:
-            raise RuntimeError("batch ingest requires sequencer='native'")
-        n = len(doc_ids) if rows is None else len(rows)
-        if not (len(clients) == len(client_seqs) == len(ref_seqs) == n):
-            raise ValueError("batch fields must have equal length")
-        rec_op, recs = self._validate_record_batch(batch, n)
-        if rows is None:
-            if self._graduated and any(d in self._graduated
-                                       for d in doc_ids):
-                raise ValueError("a targeted doc has graduated off the "
-                                 "flat tier; route its ops through "
-                                 "submit()")
-            self.flush()  # per-op queue first: per-doc seq order holds
-            rows = np.fromiter((self.doc_row(d) for d in doc_ids),
-                               np.int32, count=n)
-        else:
-            rows = np.ascontiguousarray(rows, np.int32)
-            if n and not ((rows >= 0) & (rows < self.n_docs)).all():
-                raise ValueError("row out of range")
-            self.flush()
-        uniq_rows = np.unique(rows)
-        # unknown rows fail in _fill_row_handles (no doc → KeyError)
-        self._fill_row_handles(uniq_rows, raw)
-        t0 = time.perf_counter()
-        client = np.ascontiguousarray(clients, np.int32)
-        cseq = np.ascontiguousarray(client_seqs, np.int32)
-        ref = np.ascontiguousarray(ref_seqs, np.int32)
-        out_seq, out_min, nacked, n_ok = self._sequence_columnar(
-            raw, self._row_handle[rows], client, cseq, ref,
-            "tree records batch")
-        _t_seq = time.perf_counter()
-
-        keep = ~nacked[rec_op] if len(rec_op) else np.zeros(0, bool)
-        _t_prep = None
-        if self._wire_eligible(batch):
-            _t_prep = self._dispatch_wire(batch, recs, rec_op, keep,
-                                          rows, out_seq, nacked)
-        if _t_prep is None:
-            # dense fallback: host-side table mapping + int32 planes
-            g = self._map_records(recs, batch)
-            rows_r = rows[rec_op][keep]
-            g_k = g[keep]
-            seq_r = out_seq[rec_op][keep]
-            _t_prep = time.perf_counter()
-            # device apply dispatched before the log append (host log
-            # work rides under it), exactly the string pipeline's order
-            self.store.apply_records(rows_r, g_k, seq_r)
-        _t_apply = time.perf_counter()
-
-        ok = np.flatnonzero(~nacked)
-        ts = self.deli.clock()
-        doc_tab = [self._row_doc_id[int(r)] for r in uniq_rows]
-        doc_plane = np.searchsorted(uniq_rows, rows[ok]).astype(np.int32)
-        new_idx = np.cumsum(~nacked) - 1   # op index among kept ops
-        ref_clamped = self._clamped_ref(ref, out_seq)
-        self._append_columnar(TreeRecordOps(
-            doc_tab, doc_plane,
-            client[ok], cseq[ok], ref_clamped[ok], out_seq[ok],
-            out_min[ok], new_idx[rec_op][keep],
-            np.ascontiguousarray(recs[keep]),
-            list(batch["ids"]), list(batch["fields"]),
-            list(batch["types"]), list(batch["values"]), timestamp=ts))
-        _t_log = time.perf_counter()
-        if len(ok):
-            # per-doc window floor: the LAST op of each doc carries its
-            # latest min_seq (one dict write per doc, not per op)
-            rows_ok = rows[ok]
-            order = np.argsort(rows_ok, kind="stable")
-            rs = rows_ok[order]
-            ms = out_min[ok][order]
-            starts = np.r_[0, np.flatnonzero(np.diff(rs)) + 1]
-            lasts = np.r_[starts[1:] - 1, len(rs) - 1]
-            for r, m in zip(rs[starts], ms[lasts]):
-                self._min_seq[self._row_doc_id[int(r)]] = int(m)
-        self.metrics.inc("flushes")
-        self.metrics.inc("ops_flushed", n_ok)
-        self.metrics.observe("ingest_seq_ms", (_t_seq - t0) * 1000)
-        self.metrics.observe("ingest_prep_ms", (_t_prep - _t_seq) * 1000)
-        self.metrics.observe("ingest_dispatch_ms",
-                             (_t_apply - _t_prep) * 1000)
-        self.metrics.observe("ingest_log_ms", (_t_log - _t_apply) * 1000)
-        elapsed_ms = (time.perf_counter() - t0) * 1000
-        self.metrics.observe("flush_ms", elapsed_ms)
-        tracing.TRACER.record_complete(
-            "serving.ingest_records", elapsed_ms, ops=int(n_ok),
-            nacked=int(nacked.sum()),
-            seq_ms=(_t_seq - t0) * 1000,
-            dispatch_ms=(_t_apply - _t_prep) * 1000,
-            log_ms=(_t_log - _t_apply) * 1000)
-        return {"seq": out_seq, "nacked": int(nacked.sum())}
+        w = self._ingest_prepare(doc_ids, clients, client_seqs,
+                                 ref_seqs, batch, rows=rows)
+        self._ingest_sequence(w)
+        self._ingest_dispatch(w)
+        return self._ingest_log(w)
 
     def ingest_batch(self, doc_ids: List[str], clients, client_seqs,
                      ref_seqs, ops: List[dict]) -> dict:
@@ -2992,8 +3176,13 @@ class TreeServingEngine(ServingEngineBase):
                       ) -> dict:
         """The tree FLAT volume path: N single-node inserts (op i creates
         ``node_ids[i]`` under ``parents[i]``/``fields[i]``), each ONE
-        ``INSERT_SOLO`` record — built as arrays here and run through
-        ``ingest_records``."""
+        ``INSERT_SOLO`` record. A thin validated front over
+        ``tree_wire.encode_leaf_records`` + ``ingest_records`` — flat
+        rides the SAME engine path as the general batch, so flat ≥
+        general by construction (the old duplicate per-item table
+        builder is retired). Hot-path callers pre-encode with
+        ``encode_leaf_records`` off the serving thread and drive
+        ``ingest_records``/the pipelined executor directly."""
         n = len(node_ids)
         types = types if types is not None else [None] * n
         afters = afters if afters is not None else [None] * n
@@ -3016,32 +3205,11 @@ class TreeServingEngine(ServingEngineBase):
             json.dumps(values, sort_keys=True)
         except (TypeError, ValueError) as e:
             raise ValueError(f"unserializable node value: {e}") from None
-        from .tree_wire import _LocalTable, _LocalValues
-        ids_t = _LocalTable(parse_numeric=True)
-        fields_t, types_t = _LocalTable(), _LocalTable()
-        values_t = _LocalValues()
-        recs = np.zeros((n, 8), np.int32)
-        recs[:, 0] = int(TreeOpKind.INSERT_SOLO)
-        recs[:, 1] = np.fromiter((ids_t.handle(x) for x in node_ids),
-                                 np.int32, count=n)
-        recs[:, 2] = np.fromiter((ids_t.handle(x) for x in parents),
-                                 np.int32, count=n)
-        recs[:, 3] = np.fromiter(
-            (ids_t.handle(x) if x else 0 for x in afters),
-            np.int32, count=n)
-        recs[:, 4] = np.fromiter((fields_t.handle(x) for x in fields),
-                                 np.int32, count=n)
-        recs[:, 5] = np.fromiter(
-            (0 if v is None else values_t.handle(v) for v in values),
-            np.int32, count=n)
-        recs[:, 6] = np.fromiter(
-            (0 if t is None else types_t.handle(t) for t in types),
-            np.int32, count=n)
-        batch = {"rec_op": np.arange(n, dtype=np.int64), "recs": recs,
-                 "ids": ids_t.items, "fields": fields_t.items,
-                 "types": types_t.items, "values": values_t.items}
-        return self.ingest_records(doc_ids, clients, client_seqs,
-                                   ref_seqs, batch)
+        from .tree_wire import encode_leaf_records
+        return self.ingest_records(
+            doc_ids, clients, client_seqs, ref_seqs,
+            encode_leaf_records(parents, fields, node_ids, values,
+                                types, afters))
 
     def _store_of(self, doc_id: str):
         """(store, row) owning this doc, post-flush."""

@@ -369,3 +369,109 @@ def test_pipelined_crash_between_sequencing_and_append():
                           {"mt": "insert", "kind": 0, "pos": 0,
                            "text": "z", "clientSeq": CRASH_WAVE * O + 1})
     assert nack is None and msg.seq == base + 1
+
+
+def test_tree_pipelined_crash_between_sequencing_and_append():
+    """ISSUE 7 drill: the tree record pipeline under the same crash
+    window — waves of pre-encoded tree batches in flight through the
+    staged executor, seq worker killed after native sequencing but
+    before the wave's durable TreeRecordOps append. Acked ⊆ logged,
+    the crashed wave's seqs exist nowhere durable, the victim stays
+    poisoned, and two rebuilds from summary + log converge."""
+    import numpy as np
+
+    from fluidframework_tpu.server import native_deli
+    if not native_deli.available():
+        pytest.skip("native sequencer unavailable")
+    from fluidframework_tpu.ops.tree_kernel import tree_state_digest
+    from fluidframework_tpu.server.ingest_pipeline import (
+        PipelinedIngestExecutor,
+    )
+    from fluidframework_tpu.server.serving import TreeServingEngine
+    from fluidframework_tpu.server.tree_wire import encode_tree_batch
+    from fluidframework_tpu.utils.faultpoints import SITE_INGEST_MID_BATCH
+
+    R = 4
+    eng = TreeServingEngine(n_docs=R, capacity=64,
+                            batch_window=10 ** 9, sequencer="native")
+    docs = [f"t{i}" for i in range(R)]
+    for d in docs:
+        eng.connect(d, 1)
+    summary0 = eng.summarize()
+
+    def wave_batch(w):
+        ops = []
+        for d in docs:
+            if w == 0:
+                ops.append({"op": "insert", "parent": "root",
+                            "field": "kids", "after": None,
+                            "nodes": [{"id": f"{d}-n0", "value": 0}]})
+            else:
+                ops.append({"op": "insert", "parent": "root",
+                            "field": "kids", "after": f"{d}-n{w - 1}",
+                            "nodes": [{"id": f"{d}-n{w}", "value": w}]})
+        return encode_tree_batch(ops)
+
+    CRASH_WAVE = 2                      # 0-based; third sequencing hit
+    plan = chaos.FaultPlan(crash={SITE_INGEST_MID_BATCH: CRASH_WAVE + 1})
+    ex = PipelinedIngestExecutor(eng, depth=2)
+    tickets = []
+    with armed(plan):
+        for b in range(5):
+            try:
+                tickets.append(ex.submit(docs, [1] * R, [b + 1] * R,
+                                         [0] * R, wave_batch(b)))
+            except RuntimeError:
+                break  # fail-stop: the executor already refused new work
+        with pytest.raises(RuntimeError) as ei:
+            ex.drain()
+    assert plan.fired == [SITE_INGEST_MID_BATCH]
+    assert isinstance(ei.value.__cause__, CrashInjected)
+
+    # acks are exactly the pre-crash waves; everything after fails
+    acked_keys = set()
+    for b, t in enumerate(tickets):
+        if b < CRASH_WAVE:
+            res = t.result(timeout=5)
+            assert res["nacked"] == 0
+            acked_keys.update((d, b + 1) for d in docs)
+        else:
+            err = t.error()
+            assert err is not None, f"wave {b} must not ack past a crash"
+            if b == CRASH_WAVE:
+                assert isinstance(err, CrashInjected)
+
+    # no acked op lost / no phantom seqs in the durable record stream
+    logged = {(m.doc_id, m.client_seq) for m in chaos.logged_ops(eng)}
+    assert acked_keys <= logged
+    crashed_keys = {(d, CRASH_WAVE + 1) for d in docs}
+    assert not (crashed_keys & logged)
+
+    # the victim is poisoned: device/sequencer state is ahead of the log
+    with pytest.raises(RuntimeError, match="poisoned"):
+        eng.summarize()
+    with pytest.raises(RuntimeError):
+        ex.submit(docs, [1] * R, [9] * R, [0] * R, wave_batch(0))
+    ex.close()
+
+    # deterministic replay: two independent rebuilds converge, carry
+    # every acked wave's nodes, and none of the crashed wave's
+    twins = [TreeServingEngine.load(summary0, eng.log,
+                                    sequencer="native")
+             for _ in range(2)]
+    d0 = np.asarray(tree_state_digest(twins[0].store.state))
+    d1 = np.asarray(tree_state_digest(twins[1].store.state))
+    assert (d0 == d1).all()
+    for d in docs:
+        assert twins[0].to_dict(d) == twins[1].to_dict(d)
+        for b in range(CRASH_WAVE):
+            assert twins[0].has_node(d, f"{d}-n{b}"), (d, b)
+        assert not twins[0].has_node(d, f"{d}-n{CRASH_WAVE}")
+    # post-recovery ingest resumes exactly after the acked tail
+    t0 = twins[0]
+    base = t0.deli.doc_seq(docs[0])
+    msg, nack = t0.submit(docs[0], 1, CRASH_WAVE + 1, base,
+                          {"op": "insert", "parent": "root",
+                           "field": "kids", "after": None,
+                           "nodes": [{"id": "fresh", "value": 7}]})
+    assert nack is None and msg.seq == base + 1

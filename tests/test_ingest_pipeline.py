@@ -214,6 +214,150 @@ def test_depth_exercised_and_metrics_published():
     assert snap.get("ingest_waves", 0) >= len(waves)
 
 
+# ------------------------------------------------- tree record waves
+
+def _mk_tree_engine(n_docs=8):
+    from fluidframework_tpu.server.serving import TreeServingEngine
+    eng = TreeServingEngine(n_docs=n_docs, capacity=64,
+                            batch_window=10 ** 9, sequencer="native")
+    for i in range(n_docs):
+        eng.connect(f"t{i}", 1)
+    return eng, [f"t{i}" for i in range(n_docs)]
+
+
+def _tree_waves(docs, n_waves):
+    """General waves: chained inserts + a guarded transaction per doc,
+    pre-encoded client-side (encode_tree_batch)."""
+    from fluidframework_tpu.server.tree_wire import encode_tree_batch
+    waves = []
+    for w in range(n_waves):
+        ops = []
+        for d in docs:
+            if w == 0:
+                ops.append({"op": "insert", "parent": "root",
+                            "field": "kids", "after": None,
+                            "nodes": [{"id": f"{d}-n0", "value": 0}]})
+            else:
+                prev = f"{d}-n{w - 1}"
+                ops.append({"op": "transaction",
+                            "constraints": [{"nodeExists": prev}],
+                            "edits": [
+                                {"op": "insert", "parent": "root",
+                                 "field": "kids", "after": prev,
+                                 "nodes": [{"id": f"{d}-n{w}",
+                                            "value": w}]},
+                                {"op": "setValue", "id": prev,
+                                 "value": w * 10}]})
+        waves.append(encode_tree_batch(ops))
+    return waves
+
+
+def _tree_parity(docs, waves, serial_outs, eng_s, pipe):
+    from fluidframework_tpu.ops.tree_kernel import tree_state_digest
+    eng_p, outs_p, stats = pipe
+    for b, (a, c) in enumerate(zip(serial_outs, outs_p)):
+        assert np.array_equal(np.asarray(a["seq"]),
+                              np.asarray(c["seq"])), f"seqs diverge @{b}"
+        assert a["nacked"] == c["nacked"] == 0, b
+    d_s = np.asarray(tree_state_digest(eng_s.store.state))
+    d_p = np.asarray(tree_state_digest(eng_p.store.state))
+    assert (d_s == d_p).all(), "merged tree digests diverge"
+    for d in (docs[0], docs[-1]):
+        assert eng_s.to_dict(d) == eng_p.to_dict(d), d
+    assert stats["waves"] == len(waves)
+    assert eng_p._ingest_inflight() == 0
+    eng_p._check_poisoned()
+
+
+def test_tree_records_pipelined_parity():
+    """Pipelined tree record ingest (prepacked wire on the pack worker)
+    must be observationally identical to the serial ingest_records walk
+    — same seqs, same merged trees (digests), same to_dict."""
+    eng_s, docs = _mk_tree_engine()
+    eng_p, _ = _mk_tree_engine()
+    waves = _tree_waves(docs, 5)
+    n = len(docs)
+    serial_outs = [eng_s.ingest_records(docs, [1] * n, [w + 1] * n,
+                                        [0] * n, b)
+                   for w, b in enumerate(waves)]
+    with PipelinedIngestExecutor(eng_p, depth=3) as ex:
+        tickets = [ex.submit(docs, [1] * n, [w + 1] * n, [0] * n, b)
+                   for w, b in enumerate(waves)]
+        ex.drain()
+        outs_p = [t.result() for t in tickets]
+        stats = ex.stats()
+    _tree_parity(docs, waves, serial_outs, eng_s, (eng_p, outs_p, stats))
+
+
+def test_tree_flat_pipelined_parity():
+    """The unified flat path under the executor: pre-encoded leaf
+    records (rows= fast path) match the serial walk, and a width-coded
+    u32 wave (padded id/value tables past 64k) merges identically."""
+    from fluidframework_tpu.server.tree_wire import encode_leaf_records
+    eng_s, docs = _mk_tree_engine()
+    eng_p, _ = _mk_tree_engine()
+    n = len(docs)
+    waves = []
+    for w in range(4):
+        waves.append(encode_leaf_records(
+            ["root"] * n, ["kids"] * n, [f"{d}-f{w}" for d in docs],
+            [w] * n, ["leaf"] * n,
+            [None if w == 0 else f"{d}-f{w - 1}" for d in docs]))
+    # the last wave crosses the u16 table budget: unused padding entries
+    # force the u32 id/value lanes without changing the ops
+    waves[-1] = dict(waves[-1])
+    waves[-1]["ids"] = list(waves[-1]["ids"]) + \
+        [f"pad{i}" for i in range(0x10000)]
+    waves[-1]["values"] = list(waves[-1]["values"]) + \
+        list(range(0x10000))
+    assert eng_s._wire_eligible(waves[-1])
+    rows_s = np.array([eng_s.doc_row(d) for d in docs], np.int32)
+    rows_p = np.array([eng_p.doc_row(d) for d in docs], np.int32)
+    serial_outs = [eng_s.ingest_records(None, [1] * n, [w + 1] * n,
+                                        [0] * n, b, rows=rows_s)
+                   for w, b in enumerate(waves)]
+    with PipelinedIngestExecutor(eng_p, depth=3) as ex:
+        tickets = [ex.submit(None, [1] * n, [w + 1] * n, [0] * n, b,
+                             rows=rows_p)
+                   for w, b in enumerate(waves)]
+        ex.drain()
+        outs_p = [t.result() for t in tickets]
+        stats = ex.stats()
+    _tree_parity(docs, waves, serial_outs, eng_s, (eng_p, outs_p, stats))
+
+
+def test_tree_pipelined_nacked_wave_discards_prepack():
+    """A clientSeq gap mid-stream nacks one op of one wave: the prepack
+    (packed ahead of sequencing) must be discarded and the wave repacked
+    with the keep mask — nacked records apply nowhere, later waves land."""
+    eng, docs = _mk_tree_engine()
+    n = len(docs)
+    waves = _tree_waves(docs, 4)
+    with PipelinedIngestExecutor(eng, depth=3) as ex:
+        tickets = []
+        for w, b in enumerate(waves):
+            cs = [w + 1] * n
+            if w == 3:
+                cs = list(cs)
+                cs[3] = 99          # gap: doc t3's last-wave op nacks
+            tickets.append(ex.submit(docs, [1] * n, cs, [0] * n, b))
+        ex.drain()
+        outs = [t.result() for t in tickets]
+    assert [o["nacked"] for o in outs] == [0, 0, 0, 1]
+    assert outs[3]["seq"][3] < 0
+    bad = docs[3]
+    assert eng.has_node(bad, f"{bad}-n2")
+    assert not eng.has_node(bad, f"{bad}-n3")   # nacked transaction
+    good = docs[0]
+    for w in range(4):
+        assert eng.has_node(good, f"{good}-n{w}"), w
+    # recovery replays the same trees (nacked records never logged)
+    from fluidframework_tpu.server.serving import TreeServingEngine
+    want = {d: eng.to_dict(d) for d in docs}
+    revived = TreeServingEngine.load(eng.summarize(), eng.log)
+    assert {d: revived.to_dict(d) for d in docs} == want
+
+
 def test_submit_after_close_and_result_order():
     eng = _mk_engine()
     waves = _typing_waves(2)

@@ -260,6 +260,194 @@ def test_tree_records_native_log_round_trip(tmp_path):
     assert np.array_equal(got.recs, rec.recs)
 
 
+def _batch_equal(a, b):
+    """Byte-level batch identity: same record planes AND same tables
+    (handle order included) — the vectorized encoder is a drop-in."""
+    return (np.array_equal(np.asarray(a["rec_op"]),
+                           np.asarray(b["rec_op"]))
+            and np.array_equal(np.asarray(a["recs"]),
+                               np.asarray(b["recs"]))
+            and list(a["ids"]) == list(b["ids"])
+            and list(a["fields"]) == list(b["fields"])
+            and list(a["types"]) == list(b["types"])
+            and list(a["values"]) == list(b["values"]))
+
+
+#: deterministic corpus touching every record kind the encoder emits:
+#: guarded multi-node insert, nested children, solo insert/remove/
+#: set/move, and a constrained transaction (TXN_BEGIN_EXISTS + guards)
+ALL_KINDS_OPS = [
+    {"op": "insert", "parent": "root", "field": "kids", "after": None,
+     "nodes": [{"id": "a", "type": "t", "value": 1},
+               {"id": "b", "type": None, "value": None}]},
+    {"op": "insert", "parent": "a", "field": "sub", "after": None,
+     "nodes": [{"id": "c", "type": "u", "value": [1, {"k": None}],
+                "children": {"f1": [{"id": "c1", "value": "x"}],
+                             "f2": [{"id": "c2", "type": "v"}]}}]},
+    {"op": "insert", "parent": "root", "field": "kids", "after": "a",
+     "nodes": [{"id": "solo", "value": 7}]},
+    {"op": "setValue", "id": "a", "value": {"deep": [None, 2.5]}},
+    {"op": "move", "id": "b", "parent": "a", "field": "sub",
+     "after": "c"},
+    {"op": "remove", "id": "solo"},
+    {"op": "transaction",
+     "constraints": [{"nodeExists": "a"}, {"nodeExists": "c"}],
+     "edits": [{"op": "insert", "parent": "a", "field": "sub",
+                "after": "c", "nodes": [{"id": "d", "value": 9}]},
+               {"op": "setValue", "id": "c", "value": 10},
+               {"op": "move", "id": "d", "parent": "c", "field": "f1",
+                "after": None},
+               {"op": "remove", "id": "b"}]},
+]
+
+
+def test_vectorized_encoder_matches_reference_all_kinds():
+    """The vectorized TreeBatchEncoder (one interner pass per table,
+    numpy-packed records) is byte-identical to the per-op reference
+    encoder on a corpus covering every record kind."""
+    from fluidframework_tpu.server.tree_wire import (
+        ReferenceTreeBatchEncoder,
+    )
+    vec, ref = TreeBatchEncoder(), ReferenceTreeBatchEncoder()
+    for op in ALL_KINDS_OPS:
+        assert vec.add(op) == ref.add(op)
+    assert _batch_equal(vec.batch(), ref.batch())
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_vectorized_encoder_matches_reference_fuzz(seed):
+    """Seeded parity over the oracle fuzz corpus (numeric ``#N`` ids ride
+    the int fast path; tables must still come out handle-identical)."""
+    from fluidframework_tpu.server.tree_wire import (
+        ReferenceTreeBatchEncoder,
+    )
+    _, msgs = tree_session(seed)
+    vec, ref = TreeBatchEncoder(), ReferenceTreeBatchEncoder()
+    for m in msgs:
+        vec.add(m.contents)
+        ref.add(m.contents)
+    assert _batch_equal(vec.batch(), ref.batch())
+
+
+def test_leaf_builder_matches_general_encoder():
+    """encode_leaf_records (the unified flat path) emits the same
+    INSERT_SOLO ops as the general encoder fed the equivalent one-node
+    inserts — flat is the same wire, not a parallel format. (Table
+    stream order differs — the flat builder resolves ids column-wise —
+    so the comparison is decoded-op identity, not byte identity.)"""
+    from fluidframework_tpu.server.tree_wire import (decode_records,
+                                                     encode_leaf_records)
+    n = 9
+    parents = ["root" if i % 3 else f"n{i - 1}" for i in range(n)]
+    parents[0] = "root"
+    fields = [f"f{i % 2}" for i in range(n)]
+    nids = [f"n{i}" for i in range(n)]
+    values = [None if i % 4 == 3 else {"i": i} for i in range(n)]
+    types = [None if i % 2 else "leaf" for i in range(n)]
+    afters = [None if i % 3 != 1 else f"n{i - 1}" for i in range(n)]
+    flat = encode_leaf_records(parents, fields, nids, values, types,
+                               afters)
+    general = encode_tree_batch(
+        [{"op": "insert", "parent": p, "field": f, "after": a,
+          "nodes": [{"id": i, "type": t, "value": v}]}
+         for p, f, i, v, t, a in zip(parents, fields, nids, values,
+                                     types, afters)])
+    def decoded(b):
+        return [_normalize(op) for op in decode_records(
+            b["rec_op"], b["recs"], b["ids"], b["fields"], b["types"],
+            b["values"])]
+
+    assert decoded(flat) == decoded(general)
+    assert (np.asarray(flat["recs"])[:, 0] == 9).all()  # INSERT_SOLO
+
+
+def test_ingest_leaves_is_records_path():
+    """Flat-via-records parity: ingest_leaves ≡ encode_leaf_records +
+    ingest_records — same seqs, same trees, same durable log (the thin
+    builder really did retire the duplicate pipeline)."""
+    from fluidframework_tpu.server.tree_wire import encode_leaf_records
+    eng_a, docs = _mk()
+    eng_b, _ = _mk()
+    for wave in range(3):
+        parents = ["root"] * len(docs) if wave == 0 \
+            else [f"{d}-L0" for d in docs]
+        nids = [f"{d}-L{wave}" for d in docs]
+        values = [{"w": wave}] * len(docs)
+        types = ["leaf"] * len(docs)
+        afters = [None if wave < 2 else f"{d}-L1" for d in docs]
+        cs = [wave + 1] * len(docs)
+        zeros = [0] * len(docs)
+        res_a = eng_a.ingest_leaves(docs, [1] * len(docs), cs, zeros,
+                                    parents, ["kids"] * len(docs), nids,
+                                    values, types, afters)
+        batch = encode_leaf_records(parents, ["kids"] * len(docs), nids,
+                                    values, types, afters)
+        res_b = eng_b.ingest_records(docs, [1] * len(docs), cs, zeros,
+                                     batch)
+        assert np.array_equal(np.asarray(res_a["seq"]),
+                              np.asarray(res_b["seq"]))
+        assert res_a["nacked"] == res_b["nacked"] == 0
+    for d in docs:
+        assert eng_a.to_dict(d) == eng_b.to_dict(d), d
+    la = [(m.doc_id, m.seq, m.contents) for m in
+          (m for d in docs for m in eng_a._doc_log_messages(d))]
+    lb = [(m.doc_id, m.seq, m.contents) for m in
+          (m for d in docs for m in eng_b._doc_log_messages(d))]
+    assert la == lb
+
+
+def test_wire_width_coding_u32_parity():
+    """The id/value index lanes widen u16 → u32 past 64k table entries;
+    a batch whose tables cross the boundary (padded with unused ids and
+    values) must still be wire-eligible and merge identically to the
+    unpadded ingest."""
+    eng_a, docs = _mk()
+    eng_b, _ = _mk()
+    ops = [{"op": "insert", "parent": "root", "field": "kids",
+            "after": None, "nodes": [{"id": f"{d}-n", "type": "t",
+                                      "value": 5}]} for d in docs]
+    batch = encode_tree_batch(ops)
+    padded = dict(batch)
+    padded["ids"] = list(batch["ids"]) + \
+        [f"pad{i}" for i in range(0x10000)]
+    padded["values"] = list(batch["values"]) + list(range(0x10000))
+    assert eng_a._wire_eligible(padded)
+    ones, cs, zeros = [1] * len(docs), [1] * len(docs), [0] * len(docs)
+    res_a = eng_a.ingest_records(docs, ones, cs, zeros, padded)
+    res_b = eng_b.ingest_records(docs, ones, cs, zeros, batch)
+    assert res_a["nacked"] == res_b["nacked"] == 0
+    assert np.array_equal(np.asarray(res_a["seq"]),
+                          np.asarray(res_b["seq"]))
+    for d in docs:
+        assert eng_a.to_dict(d) == eng_b.to_dict(d), d
+
+
+def test_pack_wire_records_width_parameters():
+    """pack_wire_records' u16 and u32 packings carry identical indices —
+    the width is a wire-size knob, not a semantic one — and prepack_wire
+    picks the width from the table sizes (pool buckets keyed by
+    itemsize, so u16 and u32 waves never alias a buffer)."""
+    from fluidframework_tpu.ops.tree_store import pack_wire_records
+    ops = [{"op": "insert", "parent": "root", "field": "kids",
+            "after": None, "nodes": [{"id": f"m{i}", "value": i}]}
+           for i in range(6)]
+    b = encode_tree_batch(ops)
+    recs = np.asarray(b["recs"])
+    rec_op = np.asarray(b["rec_op"])
+    rows_r = np.arange(len(rec_op), dtype=np.int64)
+    p16 = pack_wire_records(recs, rec_op, rows_r)
+    p32 = pack_wire_records(recs, rec_op, rows_r,
+                            id_t=np.uint32, val_t=np.uint32)
+    k16, ids16, vals16, row16, pos16 = p16[:5]
+    k32, ids32, vals32, row32, pos32 = p32[:5]
+    assert ids16.dtype == np.uint16 and vals16.dtype == np.uint16
+    assert ids32.dtype == np.uint32 and vals32.dtype == np.uint32
+    assert np.array_equal(ids16.astype(np.uint32), ids32)
+    assert np.array_equal(vals16.astype(np.uint32), vals32)
+    assert np.array_equal(k16, k32) and np.array_equal(row16, row32)
+    assert np.array_equal(pos16, pos32)
+
+
 def test_nested_transaction_rejected():
     eng, docs = _mk()
     nested = {"op": "transaction", "edits": [

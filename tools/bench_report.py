@@ -128,6 +128,11 @@ INGRESS_KEYS = (
     "columnar_ingress_ops_per_sec_median", "columnar_ingress_trials",
     "columnar_ingress_windows",
 )
+TREE_KEYS = (
+    "tree_serving_ops_per_sec", "tree_serving_ops_per_sec_median",
+    "tree_serving_trials", "tree_flat_serving_ops_per_sec",
+    "tree_flat_trials", "tree_kernel_ops_per_sec", "tree_kernel_trials",
+)
 
 
 def matrix_block(rec: dict) -> str | None:
@@ -137,6 +142,29 @@ def matrix_block(rec: dict) -> str | None:
         return None
     out = {"metric": "matrix_serving_ops_per_sec", "unit": "ops/s"}
     out.update({k: rec[k] for k in MATRIX_KEYS if k in rec})
+    return json.dumps(out)
+
+
+def tree_block(rec: dict) -> str | None:
+    """Tree-serving fenced block (general/flat/kernel splits plus the
+    pipelined-ingest overlap evidence), or None on records predating the
+    tree phase."""
+    if "tree_serving_ops_per_sec" not in rec:
+        return None
+    out = {"metric": "tree_serving_ops_per_sec", "unit": "ops/s"}
+    out.update({k: rec[k] for k in TREE_KEYS if k in rec})
+    stages = rec.get("ingest_stage_p50_ms")
+    if isinstance(stages, dict) and isinstance(stages.get("tree"), dict):
+        out["stage_p50_ms"] = stages["tree"]
+    walls = rec.get("ingest_wave_wall_p50_ms")
+    if isinstance(walls, dict) and "tree" in walls:
+        out["wave_wall_p50_ms"] = walls["tree"]
+    pipe = rec.get("ingest_pipeline")
+    if isinstance(pipe, dict) and isinstance(pipe.get("tree"), dict):
+        out["pipeline"] = {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in pipe["tree"].items()
+            if k in ("waves", "depth", "max_inflight", "overlap")}
     return json.dumps(out)
 
 
@@ -190,6 +218,7 @@ def regenerate(root: Path, json_path: Path | None = None,
     # the folded-in sections regenerate only when the record carries
     # them (older rounds predate the matrix/ingress phases)
     for heading, extra in (("## Matrix serving", matrix_block(rec)),
+                           ("## Tree serving", tree_block(rec)),
                            ("## Columnar ingress", ingress_block(rec))):
         if extra is not None:
             updated = update_section(updated, heading, extra)

@@ -77,7 +77,23 @@ INFO_EXACT = {"dispatch_rtt_ms", "docs", "total_ops", "contended"}
 DECLARED_FLOORS: Dict[str, float] = {
     "serving_rich_ops_per_sec": 2e6,
     "columnar_ingress_ops_per_sec": 45e3,
+    # ISSUE 7 floors: tree general waves on the width-coded wire through
+    # the pipelined executor; matrix storms on the prefix gather-merge
+    # kernel. Armed by the first (TPU) round that achieves them — CPU
+    # rounds report them unarmed/info rather than failing.
+    "tree_serving_ops_per_sec": 5e5,
+    "matrix_serving_ops_per_sec": 1e5,
 }
+
+#: Known-variance note (headline drift, r04 → r05): the merged-kernel
+#: headline moved 7.98M → 7.28M ops/s (−8.8%) with no change on the
+#: kernel path. That sits INSIDE the 10% rel_band by design: the
+#: per-suite ``headline_trials`` of a single record spread up to ~±15%
+#: (see ``headline_variance_band.spread_pct``) under test-tunnel
+#: latency noise, so a cross-round drift smaller than one record's own
+#: in-run spread is noise, not regression. Compare
+#: ``headline_variance_band.median`` across rounds — not the
+#: best-of-suite ``value`` — before reading a drift as real.
 
 
 def classify(name: str) -> Optional[str]:
