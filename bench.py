@@ -1405,6 +1405,40 @@ def run():
 
     rtt_monitor.stop()
 
+    # -------------------------------------------------- reconnect storm
+    # the resilience plane under measured load (ISSUE 9): a seeded soak
+    # (socket kills + injected sequencer crashes + service restarts over
+    # resilient clients) reported as throughput, reconnect latency
+    # percentiles, resubmit/dup-ack counts — and the invariant-violation
+    # count the perf sentinel gates on (any nonzero fails --check)
+    _phase("reconnect_storm")
+    try:
+        import importlib.util as _ilu
+        _spec = _ilu.spec_from_file_location(
+            "chaos_soak", _os.path.join(
+                _os.path.dirname(_os.path.abspath(__file__)),
+                "tools", "chaos_soak.py"))
+        _soak = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_soak)
+        _storm = _soak.run_soak(seed=123, steps=300, n_clients=4,
+                                restarts=3, kill_p=0.02, crash_p=0.005)
+        reconnect_storm = {
+            "ops_per_sec": round(
+                _storm["ops_acked"] / max(_storm["elapsed_s"], 1e-9), 1),
+            "ops_acked": _storm["ops_acked"],
+            "reconnects": _storm["reconnects"],
+            "reconnect_p50_ms": _storm["reconnect_p50_ms"],
+            "reconnect_p99_ms": _storm["reconnect_p99_ms"],
+            "resubmits": _storm["resubmits"],
+            "dup_acked": _storm["dup_acked"],
+            "socket_kills": _storm["socket_kills"],
+            "restarts": _storm["restarts"],
+            "faultpoint_fires": _storm["faultpoint_fires"],
+            "invariant_violations": _storm["violations"],
+        }
+    except Exception as e:   # noqa: BLE001 — the record must still emit
+        reconnect_storm = {"error": repr(e), "invariant_violations": -1}
+
     # observability ride-along: the unified registry's process-wide view
     # (device dispatches, jit compiles vs cache hits, oplog appends, ...)
     # plus ONE sampled span timeline from the run's newest trace, so a
@@ -1510,6 +1544,10 @@ def run():
         "columnar_ingress_trials": [round(t, 1) for t in ingress_trials],
         "columnar_ingress_windows": ingress_windows,
         "columnar_ingress_pipeline": ingress_stats,
+        # resilience under load (ISSUE 9): the seeded reconnect storm's
+        # throughput/latency plus the invariant-violation count the
+        # perf sentinel gates on
+        "reconnect_storm": reconnect_storm,
         # continuous canary, attributed per phase: worst in-phase RTT +
         # contended flag (samples taken DURING the phase, not only at
         # its boundaries)

@@ -1,0 +1,528 @@
+"""Resilient clients: reconnect/resubmit wrappers for both front doors.
+
+Reference counterpart: the Fluid client's ``DeltaManager`` reconnect
+pipeline (SURVEY.md §2.8) — on socket loss the client reconnects with
+backoff, replays its outbound queue, and relies on server-side
+``(clientId, clientSequenceNumber)`` dedup to collapse resubmits of ops
+that were already sequenced. Two wrappers here:
+
+- :class:`ResilientConnection` — the framed-JSON delta stream
+  (``server.ingress``). Tracks unacked ops, reconnects with decorrelated
+  jitter, resumes its seat via the ``resync`` frame, applies the
+  catch-up tail, **renumbers** still-pending ops contiguously above the
+  server's ``last_client_seq`` cursor (an op that was sequenced but
+  never became durable — a crash between sequencing and the log append —
+  burns its clientSeq; resending under the old number would nack
+  forever), and resubmits in order. An op is "acked" when its sequenced
+  form comes back on the stream or a ``dup_ack`` frame vouches for the
+  original seq of a resubmit.
+
+- :class:`ResilientColumnarClient` — the binary columnar door
+  (``server.columnar_ingress``). Rejoins with its prior ``client_id``
+  (keeping the server-side dedup cursor), then resubmits every pending
+  op per doc in clientSeq order; already-durable ops come back as
+  idempotent dup-acks with their original seq. No renumbering needed:
+  the columnar engine never leaves a sequenced op un-logged alive (a
+  fault between sequencing and the append poisons the engine, and a
+  rebuild replays only the durable log).
+
+Both are deterministic under injected ``random.Random`` (reconnect
+schedules replay exactly in a seeded chaos soak) and track reconnect
+latencies / resubmit counts for the bench's reconnect-storm phase.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.protocol import MessageType
+from ..server import columnar_ingress as colwire
+from ..server import wire
+from ..server.deli import NackReason
+from ..utils.backoff import Backoff
+from ..utils.telemetry import REGISTRY
+
+
+class ResilientConnection:
+    """Reconnecting wrapper for one doc's JSON delta stream.
+
+    ``submit`` records the op as pending *before* writing it to the
+    socket, so a send racing a socket death can never lose track of an
+    op: whatever the socket's fate, the op is either acked through the
+    stream or resubmitted after the next resync. ``op_acks`` maps each
+    submit's uid to its sequence number once acked — exactly once, by
+    construction of the server's durable dedup ledger.
+    """
+
+    def __init__(self, host: str, port: int, doc_id: str,
+                 rng=None, attempts: int = 8,
+                 base_delay: float = 0.02,
+                 on_op: Optional[Callable] = None):
+        self.host = host
+        self.port = port
+        self.doc_id = doc_id
+        self.attempts = attempts
+        self._backoff = Backoff(base=base_delay, cap=1.0, rng=rng)
+        self._lock = threading.RLock()
+        self._acked_cv = threading.Condition(self._lock)
+        self._uid = itertools.count(1)
+        #: cseq → (uid, op fields) — in submission order (OrderedDict so
+        #: renumbering preserves it)
+        self._pending: "OrderedDict[int, Tuple[int, dict]]" = OrderedDict()
+        self.op_acks: Dict[int, int] = {}    # uid → seq (exactly once)
+        self.nacks: List[dict] = []          # genuine rejections
+        self._client_seq = 0
+        self.client_id: Optional[int] = None
+        self.epoch = 0
+        self.last_seen_seq = 0
+        self.reconnects = 0
+        self.resubmits = 0
+        self.dup_acked = 0
+        self.reconnect_latencies: List[float] = []
+        self._op_listeners: List[Callable] = []
+        self._closed = False
+        self._sock: Optional[socket.socket] = None
+        if on_op is not None:
+            self._op_listeners.append(on_op)
+        self._connect_first()
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+
+    # ------------------------------------------------------------- connect
+
+    def _dial(self) -> socket.socket:
+        return socket.create_connection((self.host, self.port),
+                                        timeout=10.0)
+
+    def _connect_first(self) -> None:
+        last: Optional[Exception] = None
+        self._backoff.reset()
+        for i in range(self.attempts):
+            try:
+                sock = self._dial()
+                wire.send_frame(sock, {"t": "connect",
+                                       "doc": self.doc_id,
+                                       "resilient": True})
+                hello = wire.recv_frame(sock)
+                if hello.get("t") != "connected":
+                    raise wire.WireError(f"bad hello: {hello}")
+                self.client_id = int(hello["client_id"])
+                self.epoch = hello.get("epoch", 0)
+                self._sock = sock
+                return
+            except OSError as e:        # noqa: PERF203 — retry loop
+                last = e
+                if i + 1 < self.attempts:
+                    time.sleep(self._backoff.next_delay())
+        raise ConnectionError(
+            f"ingress {self.host}:{self.port} unreachable") from last
+
+    def _reconnect(self) -> None:
+        """Resync loop: new socket, reclaim the seat, absorb the catch-up
+        tail, renumber + resubmit whatever is still pending. Runs on the
+        reader thread (the only frame consumer, so no frames race it)."""
+        t0 = time.perf_counter()
+        self._backoff.reset()
+        last: Optional[Exception] = None
+        for i in range(self.attempts):
+            if self._closed:
+                return
+            time.sleep(self._backoff.next_delay())
+            try:
+                sock = self._dial()
+                wire.send_frame(sock, {
+                    "t": "resync", "doc": self.doc_id,
+                    "client_id": self.client_id,
+                    "from_seq": self.last_seen_seq})
+                # the stream attaches server-side BEFORE the catch-up
+                # fetch (no loss window, duplicate delivery possible):
+                # live op frames may arrive ahead of the resynced frame
+                while True:
+                    frame = wire.recv_frame(sock)
+                    if frame.get("t") == "resynced":
+                        break
+                    self._dispatch(frame)
+            except (OSError, wire.WireError) as e:  # noqa: PERF203
+                last = e
+                continue
+            # catch-up tail first: every still-durable in-flight op acks
+            # here (broadcast is seq-ordered, the tail is complete up to
+            # now) — what remains pending is exactly the never-durable set
+            for m in frame.get("msgs", []):
+                self._dispatch({"t": "op", "msg": m})
+            self.epoch = frame.get("epoch", self.epoch)
+            lcs = int(frame.get("last_client_seq", 0))
+            with self._lock:
+                # renumber the survivors contiguously past the server's
+                # cursor: burned clientSeqs (sequenced-but-never-durable)
+                # are skipped, submission order is preserved
+                survivors = list(self._pending.values())
+                self._pending.clear()
+                self._client_seq = lcs
+                resend = []
+                for uid, op in survivors:
+                    self._client_seq += 1
+                    op = dict(op, client_seq=self._client_seq)
+                    self._pending[self._client_seq] = (uid, op)
+                    resend.append(op)
+                self._sock = sock
+            for op in resend:
+                self.resubmits += 1
+                try:
+                    wire.send_frame(sock, op)
+                except OSError:
+                    break   # socket died again: next reconnect resubmits
+            self.reconnects += 1
+            REGISTRY.inc("session_reconnects_total")
+            self.reconnect_latencies.append(time.perf_counter() - t0)
+            return
+        if not self._closed:
+            raise ConnectionError(
+                f"resync to {self.host}:{self.port} failed "
+                f"after {self.attempts} attempts") from last
+
+    # -------------------------------------------------------------- stream
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            try:
+                frame = wire.recv_frame(self._sock)
+            except (wire.WireError, OSError):
+                if self._closed:
+                    return
+                try:
+                    self._reconnect()
+                except ConnectionError:
+                    self._closed = True
+                    with self._acked_cv:
+                        self._acked_cv.notify_all()
+                    return
+                continue
+            self._dispatch(frame)
+
+    def _dispatch(self, frame: dict) -> None:
+        t = frame.get("t")
+        if t == "op":
+            m = frame["msg"]
+            seq = int(m["seq"])
+            with self._acked_cv:
+                if seq > self.last_seen_seq:
+                    self.last_seen_seq = seq
+                if m["client_id"] == self.client_id and \
+                        m["type"] not in (int(MessageType.NOOP),
+                                          int(MessageType.CLIENT_JOIN),
+                                          int(MessageType.CLIENT_LEAVE)):
+                    self._ack(int(m["client_seq"]), seq)
+            for fn in list(self._op_listeners):
+                fn(m)
+        elif t == "dup_ack":
+            with self._acked_cv:
+                self.dup_acked += 1
+                self._ack(int(frame["client_seq"]), int(frame["seq"]))
+        elif t == "nack":
+            reason = frame.get("reason")
+            seq = frame.get("seq", -1)
+            with self._acked_cv:
+                if reason == int(NackReason.DUPLICATE) and seq > 0:
+                    # engine-tier idempotent dup-ack rides the nack frame
+                    self.dup_acked += 1
+                    self._ack(int(frame["client_seq"]), int(seq))
+                else:
+                    self._pending.pop(frame.get("client_seq"), None)
+                    self.nacks.append(frame)
+                    self._acked_cv.notify_all()
+
+    def _ack(self, client_seq: int, seq: int) -> None:
+        ent = self._pending.pop(client_seq, None)
+        if ent is not None:
+            uid, _op = ent
+            self.op_acks[uid] = seq
+            self._acked_cv.notify_all()
+
+    def on_op(self, fn: Callable) -> None:
+        self._op_listeners.append(fn)
+
+    # -------------------------------------------------------------- submit
+
+    def submit(self, contents: Any, type: MessageType = MessageType.OP,
+               ref_seq: Optional[int] = None,
+               address: Optional[str] = None) -> int:
+        """Submit one op; returns its uid (stable across renumbering —
+        look the ack up in ``op_acks[uid]``)."""
+        if self._closed:
+            raise ConnectionError("submit on closed connection")
+        with self._lock:
+            self._client_seq += 1
+            uid = next(self._uid)
+            op = {"t": "op", "contents": contents, "type": int(type),
+                  "client_seq": self._client_seq,
+                  "ref_seq": self.last_seen_seq if ref_seq is None
+                  else ref_seq,
+                  "address": address}
+            # pending BEFORE the send: a socket death mid-write still
+            # leaves the op tracked for resubmit
+            self._pending[self._client_seq] = (uid, op)
+            sock = self._sock
+        try:
+            wire.send_frame(sock, op)
+        except OSError:
+            pass    # reader notices the dead socket and resyncs
+        return uid
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Block until every submitted op is acked (or nacked); False on
+        timeout or if the connection gave up reconnecting."""
+        deadline = time.monotonic() + timeout
+        with self._acked_cv:
+            while self._pending and not self._closed:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._acked_cv.wait(left)
+            return not self._pending
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # ------------------------------------------------------------- chaos
+
+    def kill_socket(self) -> None:
+        """Simulate network loss: hard-close the raw socket. The reader
+        thread notices and runs the resync path."""
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+
+    def close(self) -> None:
+        self._closed = True
+        sock = self._sock
+        try:
+            wire.send_frame(sock, {"t": "disconnect"})
+        except (OSError, AttributeError):
+            pass
+        if sock is not None:
+            sock.close()
+        with self._acked_cv:
+            self._acked_cv.notify_all()
+
+
+class ResilientColumnarClient:
+    """Reconnecting wrapper for the binary columnar door.
+
+    Per-doc clientSeq spaces (the columnar sequencer dedups per ``(doc,
+    client)``); ``submit`` assigns the next cseq for the doc and records
+    the op pending before the send. On socket loss the reader redials
+    with jitter, re-joins with the SAME ``client_id`` (the server keeps
+    the seat and its dedup cursor), and resubmits every pending op in
+    cseq order — already-durable ones come back dup-acked with their
+    original seq via the engine's ledger.
+    """
+
+    def __init__(self, host: str, port: int, docs: List[str],
+                 rng=None, attempts: int = 8,
+                 base_delay: float = 0.02):
+        self.host = host
+        self.port = port
+        self.docs = list(docs)
+        self.attempts = attempts
+        self._backoff = Backoff(base=base_delay, cap=1.0, rng=rng)
+        self._lock = threading.RLock()
+        self._acked_cv = threading.Condition(self._lock)
+        self._closed = False
+        self.client_id: Optional[int] = None
+        self.rows: Dict[str, int] = {}
+        self.row_doc: Dict[int, str] = {}
+        self.lcs: Dict[str, int] = {}
+        self.epoch = 0
+        self._cseq: Dict[str, int] = {d: 0 for d in self.docs}
+        #: doc → OrderedDict[cseq → (kind, a0, a1, payload, ref)]
+        self._pending: Dict[str, "OrderedDict[int, tuple]"] = {
+            d: OrderedDict() for d in self.docs}
+        self.acks: Dict[str, Dict[int, int]] = {d: {} for d in self.docs}
+        self.nacks: List[tuple] = []
+        self.reconnects = 0
+        self.resubmits = 0
+        self.dup_acked = 0
+        self.reconnect_latencies: List[float] = []
+        self._sock = self._join(first=True)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+
+    # ------------------------------------------------------------- connect
+
+    def _join(self, first: bool = False) -> socket.socket:
+        sock = colwire.connect_with_backoff(
+            self.host, self.port, attempts=self.attempts)
+        req = {"t": "join", "docs": self.docs}
+        if not first:
+            req["client_id"] = self.client_id
+        sock.sendall(colwire.encode_json(req))
+        ftype, payload = colwire.read_frame(sock)
+        resp = json.loads(payload)
+        if resp.get("t") != "joined":
+            raise ConnectionError(f"bad join response: {resp}")
+        self.client_id = resp["client_id"]
+        self.rows.update(resp["rows"])
+        self.row_doc = {r: d for d, r in self.rows.items()}
+        self.lcs = dict(resp.get("lcs", {}))
+        self.epoch = resp.get("epoch", 0)
+        return sock
+
+    def _reconnect(self) -> None:
+        t0 = time.perf_counter()
+        self._backoff.reset()
+        last: Optional[Exception] = None
+        for _ in range(self.attempts):
+            if self._closed:
+                return
+            time.sleep(self._backoff.next_delay())
+            try:
+                sock = self._join()
+            except (OSError, ConnectionError) as e:  # noqa: PERF203
+                last = e
+                continue
+            with self._lock:
+                self._sock = sock
+                resend = [(d, list(pend.items()))
+                          for d, pend in self._pending.items() if pend]
+            # resubmit per doc in cseq order: durable ones dup-ack with
+            # their original seq, the rest sequence fresh — per-doc order
+            # is preserved because each doc's resend list is ordered
+            for doc, ops in resend:
+                for cs, (kind, a0, a1, payload, ref) in ops:
+                    self.resubmits += 1
+                    self._send_one(sock, doc, cs, kind, a0, a1,
+                                   payload, ref)
+            self.reconnects += 1
+            REGISTRY.inc("session_reconnects_total")
+            self.reconnect_latencies.append(time.perf_counter() - t0)
+            return
+        if not self._closed:
+            raise ConnectionError(
+                f"columnar rejoin to {self.host}:{self.port} failed "
+                f"after {self.attempts} attempts") from last
+
+    # -------------------------------------------------------------- stream
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            try:
+                ftype, payload = colwire.read_frame(self._sock)
+            except (OSError, ConnectionError):
+                if self._closed:
+                    return
+                try:
+                    self._reconnect()
+                except ConnectionError:
+                    self._closed = True
+                    with self._acked_cv:
+                        self._acked_cv.notify_all()
+                    return
+                continue
+            if ftype != ord("J"):
+                continue
+            resp = json.loads(payload)
+            if resp.get("t") == "acks":
+                rows = resp.get("rows") or [None] * len(resp["acks"])
+                with self._acked_cv:
+                    for (cs, sq), row in zip(resp["acks"], rows):
+                        doc = self.row_doc.get(row)
+                        if doc is None:
+                            continue
+                        if sq > 0:
+                            if self._pending[doc].pop(cs, None) is None \
+                                    and cs in self.acks[doc]:
+                                continue   # re-delivered ack
+                            self.acks[doc][cs] = sq
+                        else:
+                            self._pending[doc].pop(cs, None)
+                            self.nacks.append((doc, cs, sq))
+                    self._acked_cv.notify_all()
+
+    # -------------------------------------------------------------- submit
+
+    def _send_one(self, sock, doc: str, cseq: int, kind: int, a0: int,
+                  a1: int, payload, ref: int) -> None:
+        ops = np.zeros(1, dtype=colwire._OP_DTYPE)
+        ops["row"] = self.rows[doc]
+        ops["kind"] = kind
+        ops["a0"] = a0
+        ops["a1"] = a1
+        ops["tidx"] = 0
+        ops["cseq"] = cseq
+        ops["ref"] = ref
+        texts = [payload] if kind == 0 else [""]
+        props = [payload] if kind == 2 else None
+        try:
+            sock.sendall(colwire.encode_op_batch(texts, ops,
+                                                 props=props))
+        except OSError:
+            pass    # reader notices and resubmits after rejoin
+
+    def submit(self, doc: str, kind: int, a0: int, a1: int = 0,
+               payload: Any = "", ref: int = 0) -> int:
+        """Submit one op on ``doc``; returns its clientSeq (stable — the
+        columnar space never renumbers)."""
+        if self._closed:
+            raise ConnectionError("submit on closed client")
+        with self._lock:
+            self._cseq[doc] += 1
+            cs = self._cseq[doc]
+            self._pending[doc][cs] = (kind, a0, a1, payload, ref)
+            sock = self._sock
+        self._send_one(sock, doc, cs, kind, a0, a1, payload, ref)
+        return cs
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._acked_cv:
+            while any(self._pending.values()) and not self._closed:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._acked_cv.wait(left)
+            return not any(self._pending.values())
+
+    @property
+    def pending_count(self) -> int:
+        with self._lock:
+            return sum(len(p) for p in self._pending.values())
+
+    # ------------------------------------------------------------- chaos
+
+    def kill_socket(self) -> None:
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+
+    def close(self) -> None:
+        self._closed = True
+        sock = self._sock
+        try:
+            sock.sendall(colwire.encode_json({"t": "bye"}))
+        except (OSError, AttributeError):
+            pass
+        if sock is not None:
+            sock.close()
+        with self._acked_cv:
+            self._acked_cv.notify_all()

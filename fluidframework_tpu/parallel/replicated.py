@@ -120,6 +120,95 @@ def shard_ops(mesh, *planes):
     return tuple(jax.device_put(jnp.asarray(p), sh) for p in planes)
 
 
+class OplogFollower:
+    """Warm-standby replica trailing a leader engine through its durable
+    oplog — the host-tier failover half of replication (the shard_map
+    step above is the device tier).
+
+    The follower owns a SECOND engine of the same family, anchored on a
+    leader summary and sharing the leader's durable :class:`PartitionedLog`
+    (the stand-in for both replicas consuming one Kafka topic).
+    ``catch_up()`` reads each partition's new records past the follower's
+    offsets, expands columnar batches, sorts by ``(doc, seq)`` (partition
+    scan order is not chronological — same hazard ``_replay_tail``
+    documents), and replays: sequencer state, resilience state (member
+    set + dedup ledger), then the device apply queue. A per-doc
+    applied-seq cursor makes replay idempotent, so racing the leader's
+    appends is safe — a record seen twice is skipped by seq.
+
+    ``promote()`` is the failover moment: one final catch-up (the leader
+    is dead; the durable log is the complete record of everything it
+    acked), then the follower's engine IS the leader — same digests as a
+    never-failed run over the same ops, by the determinism invariant the
+    chaos drills pin. Promotion counts ``failover_promotions_total`` and
+    notes the flight recorder so a post-mortem shows when authority
+    moved.
+    """
+
+    def __init__(self, leader, family: str = "string",
+                 summary: Optional[dict] = None):
+        from ..testing.chaos import engine_class
+        self.family = family
+        self.log = leader.log
+        summary = summary if summary is not None else leader.summarize()
+        self.engine = engine_class(family).load(summary, self.log)
+        # everything up to the current sequencer state replayed at load;
+        # new records land past these cursors
+        self._offsets = [self.log.size(p)
+                         for p in range(self.log.n_partitions)]
+        self._applied: dict = {}
+        for doc_id in list(self.engine._doc_rows):
+            self._applied[doc_id] = self.engine.deli.doc_seq(doc_id)
+        self.promoted = False
+        self.caught_up_ops = 0
+
+    def catch_up(self) -> int:
+        """Drain the leader's log tail into the follower; returns the
+        number of newly applied messages. Idempotent per (doc, seq)."""
+        from ..core.protocol import MessageType
+        tail = []
+        for p in range(self.log.n_partitions):
+            size = self.log.size(p)
+            if size <= self._offsets[p]:
+                continue
+            for rec in self.log.read(p, from_offset=self._offsets[p],
+                                     to_offset=size):
+                tail.extend(rec.expand() if hasattr(rec, "expand")
+                            else (rec,))
+            self._offsets[p] = size
+        tail.sort(key=lambda m: (m.doc_id, m.seq))
+        eng = self.engine
+        n = 0
+        for msg in tail:
+            if msg.seq <= self._applied.get(msg.doc_id, 0):
+                continue    # raced an already-replayed record: skip
+            eng.deli.replay(msg)
+            eng._absorb_resilience(msg)
+            if msg.type == MessageType.OP:
+                eng._enqueue(msg.doc_id, msg)
+                eng._min_seq[msg.doc_id] = max(
+                    eng._min_seq.get(msg.doc_id, 0), msg.min_seq)
+            self._applied[msg.doc_id] = msg.seq
+            n += 1
+        if n:
+            eng._queue.sort(key=lambda dm: dm[1].seq)
+            eng.flush()
+        self.caught_up_ops += n
+        return n
+
+    def promote(self):
+        """Final catch-up from the (dead) leader's durable log, then hand
+        the engine over as the new authority."""
+        from ..utils import flight_recorder, telemetry
+        n = self.catch_up()
+        self.promoted = True
+        telemetry.REGISTRY.inc("failover_promotions_total")
+        flight_recorder.note("failover_promotion", family=self.family,
+                             final_catchup_ops=n,
+                             total_ops=self.caught_up_ops)
+        return self.engine
+
+
 class ReplicaSetMetrics:
     """Health-plane rollup for a replicated mesh (ISSUE 4 piece 3).
 

@@ -43,7 +43,6 @@ import json
 import socket
 import struct
 import threading
-import time
 import zlib
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -52,6 +51,7 @@ import numpy as np
 
 from ..core.protocol import ColumnarWireKind
 from ..utils import tracing
+from ..utils.backoff import Backoff, retry
 from ..utils.telemetry import REGISTRY
 from .ingest_pipeline import PipelinedIngestExecutor
 
@@ -184,16 +184,33 @@ class _ColSession:
         if ftype == ord("J"):
             req = json.loads(payload)
             if req.get("t") == "join":
+                resume = req.get("client_id")
+                if self.client_id is None and resume is not None:
+                    # session resumption: the client reclaims its prior
+                    # identity so the sequencer's dedup cursor still
+                    # applies to its resubmits (a fresh id would turn
+                    # every resend into a first-time op)
+                    self.client_id = int(resume)
+                    srv._next_client = max(srv._next_client,
+                                           self.client_id + 1)
+                    REGISTRY.inc("session_reconnects_total")
                 if self.client_id is None:
                     self.client_id = srv._next_client
                     srv._next_client += 1
                 rows = {}
+                lcs = {}
                 for d in req["docs"]:
-                    srv.engine.connect(d, self.client_id)
+                    if not srv.engine.is_member(d, self.client_id):
+                        # re-joining a still-seated client would RESET its
+                        # dedup cursor (client_join re-seats): resumed
+                        # members keep their seat
+                        srv.engine.connect(d, self.client_id)
                     rows[d] = srv.engine.doc_row(d)
+                    lcs[d] = srv.engine.last_client_seq(d, self.client_id)
                 self._push_json({"t": "joined",
                                  "client_id": self.client_id,
-                                 "rows": rows})
+                                 "rows": rows, "lcs": lcs,
+                                 "epoch": srv.epoch})
                 return True
             if req.get("t") == "bye":
                 return False
@@ -265,10 +282,14 @@ class ColumnarAlfred:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  window_min_rows: int = 512, window_ms: float = 2.0,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2, epoch: int = 0):
         self.engine = engine
         self.host = host
         self.port = port
+        # restart generation: bumped by whoever restarts the door after a
+        # crash (chaos soak, supervisor); clients compare epochs across
+        # rejoins to learn a restart happened and resubmit their pending
+        self.epoch = epoch
         self.window_min_rows = window_min_rows
         self.window_ms = window_ms
         # > 0: windows go through a PipelinedIngestExecutor of this depth
@@ -397,7 +418,8 @@ class ColumnarAlfred:
             loop = getattr(self, "_loop", None) or \
                 asyncio.get_running_loop()
             ticket.add_done_callback(
-                lambda t: self._bounce_ack(loop, t, sessions, cseq))
+                lambda t: self._bounce_ack(loop, t, sessions, cseq,
+                                           rows))
         else:
             with tracing.TRACER.maybe_root_span(
                     "columnar.flush_window", every=256, ops=int(n)):
@@ -406,7 +428,7 @@ class ColumnarAlfred:
                     texts=texts or [""], tidx=tidx,
                     props=props or None)
             self._fan_acks(sessions, cseq,
-                           np.asarray(res["seq"]).reshape(-1))
+                           np.asarray(res["seq"]).reshape(-1), rows)
         self.windows_flushed += 1
         self.ops_ingested += n
         REGISTRY.inc("columnar_windows_flushed")
@@ -414,27 +436,43 @@ class ColumnarAlfred:
         return n
 
     def _fan_acks(self, sessions: List[_ColSession], cseq: np.ndarray,
-                  seqs: np.ndarray) -> None:
-        """Fan a window's acks back, one frame per participating session."""
+                  seqs: np.ndarray, rows: np.ndarray) -> None:
+        """Fan a window's acks back, one frame per participating session.
+
+        Runs AFTER the durable append (serial path: ingest_planes
+        returned; pipelined path: the ticket resolved past the log
+        stage), so recording the ack in the engine's dedup ledger here
+        means a ledger hit can vouch that the op is durable — the
+        idempotent dup-ack for a resubmit re-serves the original seq.
+        The frame carries a parallel ``rows`` list (acks keep their
+        2-tuple shape for wire compatibility) so resilient clients can
+        attribute each ack to a doc."""
         per_sess: Dict[_ColSession, list] = {}
+        engine = self.engine
+        doc_of = engine._row_doc_id
         for j, sess in enumerate(sessions):
-            per_sess.setdefault(sess, []).append(
-                [int(cseq[j, 0]), int(seqs[j])])
-        for sess, acks in per_sess.items():
-            sess._push_json({"t": "acks", "acks": acks})
+            cs, sq, row = int(cseq[j, 0]), int(seqs[j]), int(rows[j])
+            if sq > 0:
+                engine.note_acked(doc_of[row], sess.client_id, cs, sq)
+            per_sess.setdefault(sess, ([], []))
+            ack_l, row_l = per_sess[sess]
+            ack_l.append([cs, sq])
+            row_l.append(row)
+        for sess, (ack_l, row_l) in per_sess.items():
+            sess._push_json({"t": "acks", "acks": ack_l, "rows": row_l})
 
     def _bounce_ack(self, loop, ticket, sessions: List[_ColSession],
-                    cseq: np.ndarray) -> None:
+                    cseq: np.ndarray, rows: np.ndarray) -> None:
         """Ticket done-callback: runs on the executor's log worker —
         bounce onto the event loop (session queues are loop-affine)."""
         try:
             loop.call_soon_threadsafe(self._ack_wave, ticket, sessions,
-                                      cseq)
+                                      cseq, rows)
         except RuntimeError:
             pass   # loop already closed (shutdown race): acks are moot
 
     def _ack_wave(self, ticket, sessions: List[_ColSession],
-                  cseq: np.ndarray) -> None:
+                  cseq: np.ndarray, rows: np.ndarray) -> None:
         self._waves_inflight -= 1
         if self._capacity is not None:
             self._capacity.set()
@@ -450,7 +488,8 @@ class ColumnarAlfred:
                 self._wake.set()
             return
         self._fan_acks(sessions, cseq,
-                       np.asarray(ticket.result()["seq"]).reshape(-1))
+                       np.asarray(ticket.result()["seq"]).reshape(-1),
+                       rows)
 
     async def _wait_capacity(self) -> None:
         """Depth backpressure: park the flusher (event loop stays free to
@@ -556,25 +595,24 @@ class ColumnarAlfred:
 def connect_with_backoff(host: str, port: int, attempts: int = 5,
                          base_delay: float = 0.05,
                          timeout: Optional[float] = None) -> socket.socket:
-    """``socket.create_connection`` with BOUNDED exponential backoff.
+    """``socket.create_connection`` with BOUNDED jittered backoff.
 
     A server restarting after a crash drill (or still binding) refuses
     connections for a beat; one retry loop here beats N ad-hoc sleeps in
     callers. Bounded: after ``attempts`` failures the last error
     propagates — an ingress that is actually down must fail loudly, not
     hang."""
-    last_err: Optional[Exception] = None
-    for i in range(attempts):
-        try:
-            return socket.create_connection((host, port), timeout=timeout)
-        except OSError as e:
-            last_err = e
-            if i < attempts - 1:
-                REGISTRY.inc("columnar_connect_backoffs")
-                time.sleep(base_delay * (2 ** i))
-    raise ConnectionError(
-        f"columnar ingress {host}:{port} unreachable after "
-        f"{attempts} attempts") from last_err
+    bo = Backoff(base=base_delay, cap=2.0,
+                 metric="columnar_connect_backoffs")
+    try:
+        return retry(
+            lambda: socket.create_connection((host, port),
+                                             timeout=timeout),
+            attempts=attempts, exceptions=(OSError,), backoff=bo)
+    except OSError as e:
+        raise ConnectionError(
+            f"columnar ingress {host}:{port} unreachable after "
+            f"{attempts} attempts") from e
 
 
 class ColumnarClient:
@@ -585,13 +623,24 @@ class ColumnarClient:
                                          attempts=connect_attempts)
         self.client_id: Optional[int] = None
         self.rows: Dict[str, int] = {}
+        self.lcs: Dict[str, int] = {}   # per-doc last accepted clientSeq
+        self.epoch = 0                  # server restart generation
 
-    def join(self, docs: List[str]) -> Dict[str, int]:
-        self.sock.sendall(encode_json({"t": "join", "docs": docs}))
+    def join(self, docs: List[str],
+             client_id: Optional[int] = None) -> Dict[str, int]:
+        """Join (or, with ``client_id``, RESUME) the given docs. A resume
+        keeps the server-side dedup cursor; the response's ``lcs`` map
+        tells the client where that cursor stands per doc."""
+        req = {"t": "join", "docs": docs}
+        if client_id is not None:
+            req["client_id"] = client_id
+        self.sock.sendall(encode_json(req))
         resp = self.recv_json()
         assert resp["t"] == "joined", resp
         self.client_id = resp["client_id"]
         self.rows.update(resp["rows"])
+        self.lcs = dict(resp.get("lcs", {}))
+        self.epoch = resp.get("epoch", 0)
         return self.rows
 
     def send_ops(self, texts: List[str], ops: np.ndarray,

@@ -37,6 +37,11 @@ class Nack:
     client_id: int
     client_seq: int
     reason: NackReason
+    #: original sequence number for an idempotently-acked DUPLICATE: when
+    #: the layer above (service/engine dedup ledger) knows the resubmitted
+    #: op's durable seq, it fills this in and the ingress acks the resend
+    #: with the original stamp instead of surfacing a nack. -1 = unknown.
+    seq: int = -1
 
 
 @dataclasses.dataclass
@@ -85,6 +90,26 @@ class DeliSequencer:
             doc_id=doc_id, client_id=client_id, client_seq=0,
             ref_seq=doc.seq - 1, seq=doc.seq, min_seq=doc.min_seq,
             type=MessageType.CLIENT_JOIN, contents={"clientId": client_id})
+
+    def is_member(self, doc_id: str, client_id: int) -> bool:
+        """Whether ``client_id`` currently holds a seat on ``doc_id``
+        (resilient reconnects must NOT re-join a still-seated client:
+        ``client_join`` resets ``last_client_seq`` and would re-open the
+        dedup window to an already-sequenced resubmit)."""
+        doc = self._docs.get(doc_id)
+        return doc is not None and client_id in doc.clients
+
+    def last_client_seq(self, doc_id: str, client_id: int) -> int:
+        """The highest clientSeq ever accepted from this client on this
+        doc (0 when unknown). Resync hands this to a reconnecting client
+        so it can renumber still-pending ops past any burned clientSeqs
+        (sequenced-but-lost ops consume a clientSeq without becoming
+        durable; resending them under the old number would nack forever)."""
+        doc = self._docs.get(doc_id)
+        if doc is None:
+            return 0
+        client = doc.clients.get(client_id)
+        return client.last_client_seq if client is not None else 0
 
     def client_leave(self, doc_id: str, client_id: int
                      ) -> Optional[SequencedDocumentMessage]:

@@ -25,6 +25,8 @@ from . import wire
 from .tinylicious import DeltaConnection, LocalService
 from ..core.protocol import MessageType
 from ..utils import tracing
+from ..utils.backoff import Backoff
+from ..utils.faultpoints import CrashInjected
 from ..utils.telemetry import REGISTRY
 
 
@@ -46,7 +48,12 @@ class _Session:
         self.conn: Optional[DeltaConnection] = None
         self.out: asyncio.Queue = asyncio.Queue(maxsize=max_outbound)
         self._nacks_seen = 0
+        self._dups_seen = 0
         self._evicted = False
+        #: resilient sessions keep their service seat across socket loss:
+        #: the client reclaims it via ``resync`` instead of re-joining
+        #: (a re-join would reset the sequencer's dedup state)
+        self.resilient = False
 
     async def run(self) -> None:
         sender = asyncio.create_task(self._send_loop())
@@ -66,11 +73,25 @@ class _Session:
                     # corrupt frame: drop THIS connection, keep serving
                     await self._error(str(e))
                     break
-                if not await self._handle(req):
+                try:
+                    if not await self._handle(req):
+                        break
+                except CrashInjected:
+                    # an armed fault plan killed the pipeline mid-request:
+                    # from this client's view the server just died — drop
+                    # the socket (resilient clients resync; the sequencer
+                    # may have burned a clientSeq, which resync's
+                    # last_client_seq renumbering absorbs)
                     break
         finally:
             if self.conn is not None and self.conn.connected:
-                self.conn.disconnect()
+                if self.resilient:
+                    # keep the seat; just stop delivering into this dead
+                    # session (resync re-binds delivery to the new socket)
+                    self.conn.listeners.clear()
+                    self.conn.signal_listeners.clear()
+                else:
+                    self.conn.disconnect()
             sender.cancel()
             self.writer.close()
 
@@ -111,12 +132,27 @@ class _Session:
         t = req.get("t")
         if t == "connect":
             self.conn = svc.connect(req["doc"])
-            self.conn.on_op(lambda m: self._push(
-                {"t": "op", "msg": wire.msg_to_wire(m)}))
-            self.conn.on_signal(lambda s: self._push(
-                {"t": "signal", "doc_id": s.doc_id,
-                 "client_id": s.client_id, "contents": s.contents}))
-            self._push({"t": "connected", "client_id": self.conn.client_id})
+            self.resilient = bool(req.get("resilient"))
+            self._attach_stream()
+            self._push({"t": "connected", "client_id": self.conn.client_id,
+                        "epoch": getattr(svc, "epoch", 0)})
+        elif t == "resync":
+            # session resumption: re-bind an existing client identity to
+            # this socket, hand back the catch-up tail plus the dedup
+            # cursor (last accepted clientSeq) so the client can ack
+            # already-durable in-flight ops and renumber the rest
+            doc, client_id = req["doc"], req["client_id"]
+            self.conn = svc.reconnect(doc, client_id)
+            self.resilient = True
+            self._nacks_seen = self._dups_seen = 0
+            self._attach_stream()
+            REGISTRY.inc("session_reconnects_total")
+            msgs = svc.get_deltas(doc, req.get("from_seq", 0))
+            self._push({"t": "resynced", "client_id": client_id,
+                        "epoch": getattr(svc, "epoch", 0),
+                        "last_client_seq": svc.last_client_seq(doc,
+                                                               client_id),
+                        "msgs": [wire.msg_to_wire(m) for m in msgs]})
         elif t == "op":
             if self.conn is None:
                 await self._error("not connected")
@@ -158,13 +194,26 @@ class _Session:
             return False
         return True
 
+    def _attach_stream(self) -> None:
+        self.conn.on_op(lambda m: self._push(
+            {"t": "op", "msg": wire.msg_to_wire(m)}))
+        self.conn.on_signal(lambda s: self._push(
+            {"t": "signal", "doc_id": s.doc_id,
+             "client_id": s.client_id, "contents": s.contents}))
+
     def _drain_nacks(self) -> None:
-        """Nacks recorded on the service connection by the (synchronous)
-        pipeline are pushed to the client as frames."""
+        """Nacks (and idempotent duplicate acks) recorded on the service
+        connection by the (synchronous) pipeline are pushed to the client
+        as frames."""
         while self._nacks_seen < len(self.conn.nacks):
             nack = self.conn.nacks[self._nacks_seen]
             self._nacks_seen += 1
             self._push({"t": "nack", **wire.nack_to_wire(nack)})
+        while self._dups_seen < len(self.conn.dup_acks):
+            dup = self.conn.dup_acks[self._dups_seen]
+            self._dups_seen += 1
+            self._push({"t": "dup_ack", "doc_id": dup.doc_id,
+                        "client_seq": dup.client_seq, "seq": dup.seq})
 
 
 class AlfredServer:
@@ -185,6 +234,8 @@ class AlfredServer:
         # bounded bind retry: a fixed port vacated by a crashed
         # predecessor can linger in TIME_WAIT for a beat; an ephemeral
         # port (0) binds first try and skips the loop entirely
+        bo = Backoff(base=base_delay, cap=2.0,
+                     metric="ingress_bind_retries")
         for i in range(bind_attempts):
             try:
                 self._server = await asyncio.start_server(
@@ -193,8 +244,7 @@ class AlfredServer:
             except OSError:
                 if i == bind_attempts - 1:
                     raise
-                REGISTRY.inc("ingress_bind_retries")
-                await asyncio.sleep(base_delay * (2 ** i))
+                await asyncio.sleep(bo.next_delay())
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def _accept(self, reader, writer) -> None:

@@ -22,11 +22,12 @@ latency exactly like the reference's outbox flush policy.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -44,6 +45,93 @@ from ..ops.string_store import TensorStringStore
 from ..ops.tree_kernel import TreeOpKind
 from .deli import DeliSequencer, Nack, NackReason
 from .oplog import PartitionedLog, partition_of
+
+
+class DedupLedger:
+    """Host-side durable-dedup ledger: per ``(doc, client)`` the recent
+    ``clientSeq → seq`` acks, recorded only AFTER the op's durable append
+    committed. Two jobs: (a) idempotent dup-acks — a resubmitted op whose
+    original ack was lost is re-acked with its original seq instead of
+    nacked/re-sequenced; (b) the resync cursor — ``last()`` tells a
+    reconnecting client the highest clientSeq the service durably
+    accepted, so it can renumber still-pending ops. Bounded per key (a
+    client's in-flight window is far smaller than ``window``); snapshots
+    ride the engine summary so the ledger survives restarts, and
+    ``_replay_tail`` re-records the tail.
+    """
+
+    def __init__(self, window: int = 512):
+        self.window = window
+        self._led: Dict[Tuple[str, int], "collections.OrderedDict"] = {}
+        self._last: Dict[Tuple[str, int], int] = {}
+        # the ack fan records on the ingress event loop while the
+        # pipelined executor's sequencing worker looks up dup slots —
+        # off the hot path (records are small per-window loops, lookups
+        # only happen for rare DUPLICATE nacks), so a plain lock is fine
+        self._lock = threading.Lock()
+
+    def record(self, doc_id: str, client_id: int, client_seq: int,
+               seq: int) -> None:
+        key = (doc_id, int(client_id))
+        with self._lock:
+            led = self._led.get(key)
+            if led is None:
+                led = self._led[key] = collections.OrderedDict()
+            led[int(client_seq)] = int(seq)
+            while len(led) > self.window:
+                led.popitem(last=False)
+            if client_seq > self._last.get(key, 0):
+                self._last[key] = int(client_seq)
+
+    def lookup(self, doc_id: str, client_id: int,
+               client_seq: int) -> Optional[int]:
+        with self._lock:
+            led = self._led.get((doc_id, int(client_id)))
+            return None if led is None else led.get(int(client_seq))
+
+    def last(self, doc_id: str, client_id: int) -> int:
+        with self._lock:
+            return self._last.get((doc_id, int(client_id)), 0)
+
+    def snapshot(self, docs=None) -> dict:
+        """Full snapshot, or — ``docs`` given — only those docs' entries
+        (the O(changed) slice an incremental summary carries)."""
+        out: Dict[str, Dict[str, dict]] = {}
+        with self._lock:
+            for (doc, cid), led in self._led.items():
+                if docs is not None and doc not in docs:
+                    continue
+                out.setdefault(doc, {})[str(cid)] = {
+                    "last": self._last.get((doc, cid), 0),
+                    "acked": [[cs, sq] for cs, sq in led.items()]}
+        return out
+
+    def merge(self, partial: Optional[dict]) -> None:
+        """Overlay a delta-summary slice: each ``(doc, client)`` entry in
+        the slice replaces the ledger's (the slice is that key's full
+        current window, not an increment)."""
+        for doc, clients in (partial or {}).items():
+            for cid, ent in clients.items():
+                key = (doc, int(cid))
+                with self._lock:
+                    self._last[key] = max(self._last.get(key, 0),
+                                          int(ent.get("last", 0)))
+                    led = self._led[key] = collections.OrderedDict()
+                    for cs, sq in ent.get("acked", []):
+                        led[int(cs)] = int(sq)
+
+    @classmethod
+    def load(cls, snapshot: Optional[dict],
+             window: int = 512) -> "DedupLedger":
+        self = cls(window=window)
+        for doc, clients in (snapshot or {}).items():
+            for cid, ent in clients.items():
+                key = (doc, int(cid))
+                self._last[key] = int(ent.get("last", 0))
+                led = self._led[key] = collections.OrderedDict()
+                for cs, sq in ent.get("acked", []):
+                    led[int(cs)] = int(sq)
+        return self
 
 
 def make_sequencer(kind: str = "python", clock=None):
@@ -274,6 +362,14 @@ class ServingEngineBase:
         # round-robin partition cursor for whole-batch columnar records
         # (see _append_columnar)
         self._col_part = 0
+        # session-resilience state: the durable-dedup ledger (idempotent
+        # dup-acks + resync cursors) and the current member set — both
+        # rebuilt by _replay_tail and persisted in _base_summary, because
+        # the NATIVE sequencer's client_join resets its dedup window (a
+        # restarted/rejoined identity must not re-accept old clientSeqs)
+        self._dedup = DedupLedger()
+        self._members: Set[Tuple[str, int]] = set()
+        self._dup_acked_last = 0
         # set when the device state may be AHEAD of the durable log (a
         # log append failed after the merge was dispatched): every ingest
         # and summary refuses until the engine is rebuilt via load() —
@@ -336,7 +432,28 @@ class ServingEngineBase:
         self._dirty_outside_ops.clear()
         self._summ_bookkeeping = {
             "summary": summary, "doc_seqs": cur_seqs,
-            "row_of": dict(self._doc_rows), **extra}
+            "row_of": dict(self._doc_rows),
+            "members": frozenset(self._members), **extra}
+
+    def _mark_delta(self, summary: dict, prev: dict,
+                    cur_seqs: dict) -> None:
+        """Stamp a ``_base_summary()`` as a delta over ``prev`` and slim
+        its resilience state to O(changed): the dedup ledger rides only
+        for docs that sequenced an op since the base, membership as a
+        join/leave diff — an idle 512-doc mesh must not re-ship the full
+        ledger and roster in every delta. ``_restore_base`` resolves the
+        chain (base ledger/roster, then each delta's slice)."""
+        summary["kind"] = "delta"
+        summary["base"] = prev["summary"]
+        changed = {d for d, s in cur_seqs.items()
+                   if s != prev["doc_seqs"].get(d)}
+        summary["dedup"] = self._dedup.snapshot(docs=changed)
+        cur = frozenset(self._members)
+        base_members = prev.get("members", frozenset())
+        del summary["members"]
+        summary["members_delta"] = {
+            "join": sorted([d, c] for d, c in cur - base_members),
+            "leave": sorted([d, c] for d, c in base_members - cur)}
 
     @staticmethod
     def resolve_summary_chain(summary: dict):
@@ -419,9 +536,16 @@ class ServingEngineBase:
     # ops the log never recorded).
 
     def _sequence_columnar(self, raw, handles, client, client_seq,
-                           ref_seq, what: str):
+                           ref_seq, what: str, doc_of=None):
         """One native sequencing call + the poison sentinel + nack
-        metrics. Returns (out_seq, out_min, nacked mask, n_ok)."""
+        metrics. Returns (out_seq, out_min, nacked mask, n_ok).
+
+        ``doc_of`` (flat slot index → doc id) arms the idempotent dup-ack
+        path: DUPLICATE-nacked slots found in the dedup ledger get their
+        ORIGINAL seq patched into ``out_seq`` (positive, so the ack fan
+        re-acks them) while staying in the ``nacked`` mask (never
+        re-applied, never re-logged). ``self._dup_acked_last`` counts
+        them for the caller's result dict."""
         out_seq, out_min = raw.sequence_batch_rows(
             handles, client, client_seq, ref_seq)
         with self._poison_lock:
@@ -432,9 +556,22 @@ class ServingEngineBase:
         fault_point(SITE_INGEST_MID_BATCH, what=what)
         nacked = out_seq < 0
         n_ok = int((~nacked).sum())
+        n_dup = 0
+        if doc_of is not None and nacked.any():
+            # -3 = the native DUPLICATE nack code (see _NACK_BY_CODE)
+            for i in np.flatnonzero(out_seq == -3):
+                orig = self._dedup.lookup(doc_of(int(i)), int(client[i]),
+                                          int(client_seq[i]))
+                if orig is not None:
+                    out_seq[i] = orig
+                    n_dup += 1
+        self._dup_acked_last = n_dup
         self.metrics.inc("ops_ingested", n_ok)
-        if nacked.any():
-            self.metrics.inc("nacks", int(nacked.sum()))
+        if n_dup:
+            REGISTRY.inc("resubmit_dups_acked_total", n_dup)
+        n_nack = int(nacked.sum()) - n_dup
+        if n_nack:
+            self.metrics.inc("nacks", n_nack)
         return out_seq, out_min, nacked, n_ok
 
     @staticmethod
@@ -476,6 +613,7 @@ class ServingEngineBase:
         # doc to a tier it should not land on
         msg = self.deli.client_join(doc_id, client_id)
         self._log_append(doc_id, msg)
+        self._members.add((doc_id, int(client_id)))
         return msg
 
     def disconnect(self, doc_id: str, client_id: int
@@ -483,7 +621,33 @@ class ServingEngineBase:
         msg = self.deli.client_leave(doc_id, client_id)
         if msg is not None:
             self._log_append(doc_id, msg)
+        self._members.discard((doc_id, int(client_id)))
         return msg
+
+    def is_member(self, doc_id: str, client_id: int) -> bool:
+        """Whether this identity already holds a seat (a resuming client
+        must NOT re-join: ``client_join`` resets the sequencer's dedup
+        window, re-opening it to already-sequenced resubmits). Tracked
+        host-side because the native sequencer doesn't expose it."""
+        return (doc_id, int(client_id)) in self._members
+
+    def last_client_seq(self, doc_id: str, client_id: int) -> int:
+        """Resync cursor: the highest clientSeq durably accepted from
+        this identity (dedup-ledger view; the Python sequencer's live
+        counter — which also covers sequenced-but-unlogged burns — wins
+        when available)."""
+        lcs = self._dedup.last(doc_id, client_id)
+        live = getattr(self.deli, "last_client_seq", None)
+        if callable(live):
+            lcs = max(lcs, live(doc_id, client_id))
+        return lcs
+
+    def note_acked(self, doc_id: str, client_id: int, client_seq: int,
+                   seq: int) -> None:
+        """Ack-path ledger hook: the ingress tier records each op at the
+        moment it acks (post-durable-append), arming idempotent dup-acks
+        for later resubmits of the same op."""
+        self._dedup.record(doc_id, client_id, client_seq, seq)
 
     # --------------------------------------------------------------- ingress
 
@@ -510,6 +674,15 @@ class ServingEngineBase:
                 contents)
             if nack is not None:
                 self._unadmit(doc_id, contents)
+                if nack.reason == NackReason.DUPLICATE:
+                    orig = self._dedup.lookup(doc_id, client_id,
+                                              client_seq)
+                    if orig is not None:
+                        # idempotent dup-ack: the resubmit is durable at
+                        # ``orig`` — hand the original stamp back instead
+                        # of a bare nack (callers check nack.seq >= 0)
+                        nack.seq = orig
+                        REGISTRY.inc("resubmit_dups_acked_total")
                 return self._nacked(nack)
             self.metrics.inc("ops_ingested")
             sp.annotate(seq=msg.seq)
@@ -525,6 +698,8 @@ class ServingEngineBase:
             fault_point(SITE_SUBMIT_POST_SEQUENCE, doc_id=doc_id,
                         seq=msg.seq)
             self._log_append(doc_id, msg)
+            # durable now: ledger the ack for idempotent resubmit handling
+            self._dedup.record(doc_id, client_id, client_seq, msg.seq)
             self._record_attribution(msg)
             self._enqueue(doc_id, msg)
             self._min_seq[doc_id] = msg.min_seq
@@ -693,6 +868,8 @@ class ServingEngineBase:
                             for p in range(self.log.n_partitions)],
             "doc_rows": dict(self._doc_rows),
             "min_seq": dict(self._min_seq),
+            "dedup": self._dedup.snapshot(),
+            "members": [[d, c] for d, c in sorted(self._members)],
         }
         if self._attributors is not None:
             out["attribution"] = {d: a.summarize()
@@ -709,6 +886,19 @@ class ServingEngineBase:
         self._free_rows = [r for r in range(self._next_row)
                            if r not in used]
         self._min_seq = dict(summary["min_seq"])
+        # resilience state (absent from pre-resilience summaries): a
+        # delta chain carries the full ledger/roster only in its base
+        # full summary plus an O(changed) slice per delta — resolve
+        # oldest→newest so the restored state matches the live one
+        full, deltas = self.resolve_summary_chain(summary)
+        self._dedup = DedupLedger.load(full.get("dedup"))
+        members = {(d, int(c)) for d, c in full.get("members") or []}
+        for d_sum in deltas:
+            self._dedup.merge(d_sum.get("dedup"))
+            md = d_sum.get("members_delta") or {}
+            members |= {(d, int(c)) for d, c in md.get("join", [])}
+            members -= {(d, int(c)) for d, c in md.get("leave", [])}
+        self._members = members
         if summary.get("attribution") is not None:
             self._attributors = {d: Attributor.load(a)
                                  for d, a in summary["attribution"].items()}
@@ -741,6 +931,7 @@ class ServingEngineBase:
         tail.sort(key=lambda m: (m.doc_id, m.seq))
         for msg in tail:
             self.deli.replay(msg)
+            self._absorb_resilience(msg)
             self._record_attribution(msg)
             if control_hook is not None and control_hook(msg):
                 continue
@@ -749,6 +940,19 @@ class ServingEngineBase:
                 self._min_seq[msg.doc_id] = max(
                     self._min_seq.get(msg.doc_id, 0), msg.min_seq)
         self._queue.sort(key=lambda dm: dm[1].seq)
+
+    def _absorb_resilience(self, msg: SequencedDocumentMessage) -> None:
+        """Fold one replayed message into the resilience state (member
+        set + dedup ledger) — the durable half of (clientId, clientSeq)
+        dedup: a rebuilt engine must refuse (and idempotently re-ack)
+        clientSeqs it accepted in its previous life."""
+        if msg.type == MessageType.CLIENT_JOIN:
+            self._members.add((msg.doc_id, int(msg.client_id)))
+        elif msg.type == MessageType.CLIENT_LEAVE:
+            self._members.discard((msg.doc_id, int(msg.client_id)))
+        elif msg.type == MessageType.OP and msg.client_id >= 0:
+            self._dedup.record(msg.doc_id, msg.client_id,
+                               msg.client_seq, msg.seq)
 
 
 class _IngestWave:
@@ -762,7 +966,7 @@ class _IngestWave:
         "flat_client_seq", "flat_ref_seq", "handles", "prepacked",
         "pipelined", "prep_ms", "seq_ms", "out_seq", "out_min", "nacked",
         "n_ok", "kind_eff", "seq_rs", "seq_base", "n_valid", "min_rs",
-        "compact_due", "ms_arr", "apply_stats", "ov_prev")
+        "compact_due", "ms_arr", "apply_stats", "ov_prev", "dup_acked")
 
     def __init__(self):
         self.prepacked = None
@@ -1102,12 +1306,17 @@ class StringServingEngine(ServingEngineBase):
         raw = self.deli.raw
         _t0 = time.perf_counter()
         self.flush()  # per-op queue first: per-doc seq order must hold
+        rdi_rows = w.rows
         out_seq, out_min, nacked, n_ok = self._sequence_columnar(
             raw, w.handles, w.flat_client, w.flat_client_seq,
-            w.flat_ref_seq, "columnar batch")
+            w.flat_ref_seq, "columnar batch",
+            doc_of=lambda i: self._row_doc_id[rdi_rows[i // w.O]])
         _t_seq = time.perf_counter()
         w.out_seq, w.out_min, w.nacked, w.n_ok = out_seq, out_min, \
             nacked, n_ok
+        # dup-acked resubmits: nacked (not re-applied/re-logged) but carry
+        # their original positive seq in out_seq so the ack fan re-acks
+        w.dup_acked = self._dup_acked_last
         R, O = w.R, w.O
         # nacked slots become NOOP (they consumed no seq); the store
         # rebuilds per-op seqs on device from each doc's base — only
@@ -1298,7 +1507,9 @@ class StringServingEngine(ServingEngineBase):
                 self._ov_recover_due = True
             else:
                 self.recover_overflowed()
-        return {"seq": w.seq_rs, "nacked": int(nacked.sum())}
+        n_dup = int(getattr(w, "dup_acked", 0) or 0)
+        return {"seq": w.seq_rs, "nacked": int(nacked.sum()) - n_dup,
+                "dup_acked": n_dup}
 
     # ----------------------------------------------------------- device side
 
@@ -1595,8 +1806,7 @@ class StringServingEngine(ServingEngineBase):
         if self._incremental_ok(incremental):
             dirty_rows, cur_seqs = self._dirty_rows_since(prev)
             summary = self._base_summary()
-            summary["kind"] = "delta"
-            summary["base"] = prev["summary"]
+            self._mark_delta(summary, prev, cur_seqs)
             summary["store_delta"] = self.store.snapshot_rows(
                 sorted(dirty_rows), prev["payloads_len"],
                 prev["prop_values_len"])
@@ -1915,8 +2125,7 @@ class MapServingEngine(ServingEngineBase):
         if self._incremental_ok(incremental):
             dirty_rows, cur_seqs = self._dirty_rows_since(prev)
             summary = self._base_summary()
-            summary["kind"] = "delta"
-            summary["base"] = prev["summary"]
+            self._mark_delta(summary, prev, cur_seqs)
             summary["store_delta"] = self.store.snapshot_rows(
                 sorted(dirty_rows), prev["values_len"])
             self._chain_depth += 1
@@ -2564,8 +2773,7 @@ class MatrixServingEngine(ServingEngineBase):
             dirty_rows, cur_seqs = self._dirty_rows_since(prev)
             dirty = sorted(dirty_rows)
             summary = self._base_summary()
-            summary["kind"] = "delta"
-            summary["base"] = prev["summary"]
+            self._mark_delta(summary, prev, cur_seqs)
             summary["cells_delta"] = self.store.snapshot_delta(
                 prev["mx_bases"]) if dirty else None
             axis_rows = [a for r in dirty for a in (2 * r, 2 * r + 1)]
@@ -3489,8 +3697,7 @@ class TreeServingEngine(ServingEngineBase):
         if self._incremental_ok(incremental):
             dirty_rows, cur_seqs = self._dirty_rows_since(prev)
             summary = self._base_summary()
-            summary["kind"] = "delta"
-            summary["base"] = prev["summary"]
+            self._mark_delta(summary, prev, cur_seqs)
             summary["store_delta"] = self.store.snapshot_rows(
                 sorted(dirty_rows), prev["interner_bases"])
             summary["graduated"] = {d: s.snapshot()

@@ -12,16 +12,27 @@ sockets.
 
 from __future__ import annotations
 
+import collections
+import os
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core.protocol import (
     MessageType, SequencedDocumentMessage, SignalMessage,
 )
 from ..utils import tracing
-from .deli import DeliSequencer, Nack
+from ..utils.telemetry import REGISTRY
+from .deli import DeliSequencer, Nack, NackReason
 from .oplog import PartitionedLog, partition_of
 from .services import Broadcaster, Historian, Scribe, Scriptorium
+
+#: per-(doc, client) dedup-ledger window: how many recent clientSeq→seq
+#: acks are retained for idempotent dup-acking. A client's in-flight
+#: window (ops submitted but unacked) is far smaller than this, so any
+#: resubmitted op is either in the ledger (dup-acked with its original
+#: seq) or was never durable (plain DUPLICATE nack → the client
+#: renumbers and resends).
+_DEDUP_WINDOW = 512
 
 
 class DeltaConnection:
@@ -36,6 +47,9 @@ class DeltaConnection:
         self.listeners: List[Callable[[SequencedDocumentMessage], None]] = []
         self.signal_listeners: List[Callable[[SignalMessage], None]] = []
         self.nacks: List[Nack] = []
+        #: resubmits recognized by the dedup ledger: acked idempotently
+        #: with the ORIGINAL seq (``Nack.seq``) instead of nacked
+        self.dup_acks: List[Nack] = []
         self.connected = True
 
     def submit(self, contents: Any, type: MessageType = MessageType.OP,
@@ -96,6 +110,16 @@ class LocalService:
         self._lock = threading.RLock()
         self.nacks: List[Nack] = []
         self._connections: Dict[int, DeltaConnection] = {}
+        # durable-dedup ledger: (doc, client) -> OrderedDict[clientSeq,
+        # seq] of recently acked ops, recorded only AFTER the sequenced
+        # message is durable in the deltas log — a resubmit is dup-acked
+        # with its original seq iff that seq can never be lost
+        self._acked: Dict[Tuple[str, int],
+                          "collections.OrderedDict[int, int]"] = {}
+        #: session epoch: bumped by every :meth:`recover`, handed to
+        #: clients at connect/resync so they can tell a reconnect to the
+        #: same instance from a reconnect across a restart
+        self.epoch = 0
         # wire the pipeline: raw -> deli -> deltas -> fan-out lambdas
         for p in range(n_partitions):
             self.raw_log.subscribe(p, self._deli_consume)
@@ -115,6 +139,40 @@ class LocalService:
             join = self.deli.client_join(doc_id, client_id)
             self._publish(join)
         return conn
+
+    def reconnect(self, doc_id: str, client_id: int) -> DeltaConnection:
+        """Session resumption: re-bind an existing client identity to a
+        fresh connection WITHOUT re-sequencing a join (``client_join``
+        resets the dedup state — re-joining a still-seated client would
+        let an already-sequenced resubmit double-apply). Used by the
+        ingress resync path after a socket loss or a service restart."""
+        with self._lock:
+            old = self._connections.get(client_id)
+            if old is not None and old.connected and old.doc_id == doc_id:
+                # the previous socket's delivery is a zombie: detach it
+                # without sequencing a leave (the seat stays held)
+                self.broadcaster.leave(doc_id, old._deliver)
+                old.connected = False
+            conn = DeltaConnection(self, doc_id, client_id)
+            conn._client_seq = self.deli.last_client_seq(doc_id, client_id)
+            self._connections[client_id] = conn
+            self.broadcaster.join(doc_id, self._deliver_to(conn))
+            if not self.deli.is_member(doc_id, client_id):
+                # across a restart the seat may have been released (clean
+                # leave replayed from the log): re-join, dedup continuity
+                # coming from the ledger rather than ClientState
+                join = self.deli.client_join(doc_id, client_id)
+                self._publish(join)
+            self._next_client = max(self._next_client, client_id + 1)
+        return conn
+
+    def last_client_seq(self, doc_id: str, client_id: int) -> int:
+        """Highest clientSeq the sequencer ever accepted from this client
+        (resync contract: the client renumbers still-pending ops past
+        this so burned clientSeqs — sequenced-but-lost ops — cannot
+        wedge the resubmit stream)."""
+        with self._lock:
+            return self.deli.last_client_seq(doc_id, client_id)
 
     def _deliver_to(self, conn: DeltaConnection):
         def deliver(msg: SequencedDocumentMessage):
@@ -162,6 +220,20 @@ class LocalService:
                     raw["contents"], raw.get("address"))
                 if nack is not None:
                     sp.annotate(nacked=int(nack.reason))
+                    if nack.reason == NackReason.DUPLICATE:
+                        orig = self._acked.get(
+                            (nack.doc_id, nack.client_id), {}
+                        ).get(nack.client_seq)
+                        if orig is not None:
+                            # idempotent ack: the resubmitted op is
+                            # durable at seq ``orig`` — ack it again
+                            # with the original stamp, never re-sequence
+                            nack.seq = orig
+                            REGISTRY.inc("resubmit_dups_acked_total")
+                            conn = self._connections.get(nack.client_id)
+                            if conn is not None:
+                                conn.dup_acks.append(nack)
+                            return
                     self.nacks.append(nack)
                     conn = self._connections.get(nack.client_id)
                     if conn is not None:
@@ -173,10 +245,26 @@ class LocalService:
                 if sp.ctx is not None:
                     msg.trace = sp.ctx.to_wire()
                 self._publish(msg)
+                # durable now (the deltas append returned): ledger the
+                # (clientSeq → seq) mapping for idempotent dup-acks
+                self._note_acked(msg)
 
     def _publish(self, msg: SequencedDocumentMessage) -> None:
         p = partition_of(msg.doc_id, self.deltas_log.n_partitions)
         self.deltas_log.append(p, msg)
+
+    def _note_acked(self, msg: SequencedDocumentMessage) -> None:
+        """Record a durably-sequenced op in the dedup ledger (bounded per
+        (doc, client); only types that consume a clientSeq matter)."""
+        if msg.client_id < 0 or msg.type in (
+                MessageType.NOOP, MessageType.CLIENT_JOIN,
+                MessageType.CLIENT_LEAVE):
+            return
+        led = self._acked.setdefault(
+            (msg.doc_id, msg.client_id), collections.OrderedDict())
+        led[msg.client_seq] = msg.seq
+        while len(led) > _DEDUP_WINDOW:
+            led.popitem(last=False)
 
     def _deltas_consume(self, partition: int, offset: int,
                         msg: SequencedDocumentMessage) -> None:
@@ -211,6 +299,92 @@ class LocalService:
 
     def latest_summary(self, doc_id: str):
         return self.historian.latest_summary(doc_id)
+
+    # --------------------------------------------------------------- recovery
+
+    @classmethod
+    def recover(cls, spill_dir: str, n_partitions: int = 4) -> "LocalService":
+        """Rebuild the full service from its JSONL spill after a crash —
+        the durable-dedup path the reference service gets from Deli
+        checkpoints + Kafka replay. Two steps:
+
+        1. replay the durable deltas stream through ``deli.replay`` /
+           scriptorium (sequencer counters — including every client's
+           ``last_client_seq`` — and the catch-up store come back);
+        2. wire the pipeline subscribers at the CURRENT offsets (no
+           double-consumption of the replayed backlog).
+
+        The raw-log backlog is deliberately NOT re-fed through the
+        sequencer. A raw record whose sequencing the crash swallowed (a
+        "burned" clientSeq: accepted, maybe sequenced in memory, never
+        durable) looks recoverable — but re-feeding it here races the
+        client's own recovery: a resilient client that resynced against
+        the pre-crash instance has already RENUMBERED that op past
+        ``last_client_seq`` and will resubmit it under the new number.
+        Re-feeding the raw original would then sequence the same content
+        twice under two clientSeqs — a double apply the dedup ledger
+        cannot see. Un-acked ops are instead recovered by client
+        resubmission (``drivers.resilient``); non-resilient clients may
+        lose un-acked ops, which is the documented contract: an un-acked
+        op may be dropped, but never corrupts.
+
+        Every acked op survives (ack ⇒ durable in the deltas spill ⇒
+        replayed in step 1) and no resubmit can double-apply (step 1
+        restored the dedup state that guards it).
+        """
+        self = cls.__new__(cls)
+        self.raw_log = PartitionedLog.recover(
+            n_partitions, spill_dir, "rawdeltas")
+        self.deltas_log = PartitionedLog.recover(
+            n_partitions, spill_dir, "deltas")
+        self.deli = DeliSequencer()
+        self.broadcaster = Broadcaster()
+        self.scriptorium = Scriptorium()
+        self.historian = Historian()
+        self.scribe = Scribe(self.historian)
+        self._next_client = 1
+        self._lock = threading.RLock()
+        self.nacks = []
+        self._connections = {}
+        self._acked = {}
+        self.epoch = self._bump_epoch(spill_dir)
+        # 1) the durable deltas stream IS the recovery truth: global
+        # (doc, seq) order mirrors _replay_tail's convention
+        msgs: List[SequencedDocumentMessage] = []
+        for p in range(n_partitions):
+            msgs.extend(self.deltas_log.read(p))
+        msgs.sort(key=lambda m: (m.doc_id, m.seq))
+        for m in msgs:
+            if m.client_id >= self._next_client:
+                self._next_client = m.client_id + 1
+            self.deli.replay(m)
+            self.scriptorium.store(m)
+            self._note_acked(m)
+        # 2) subscribers from the current tail — the backlog was consumed
+        # by its previous life
+        for p in range(n_partitions):
+            self.deltas_log.subscribe(
+                p, self._deltas_consume, from_offset=self.deltas_log.size(p))
+        # raw intake re-wired at the CURRENT tail only — see the
+        # docstring for why the backlog must not be re-fed
+        for p in range(n_partitions):
+            self.raw_log.subscribe(
+                p, self._deli_consume, from_offset=self.raw_log.size(p))
+        REGISTRY.inc("service_recoveries_total")
+        return self
+
+    @staticmethod
+    def _bump_epoch(spill_dir: str) -> int:
+        """Monotone restart counter persisted beside the spill (clients
+        compare epochs to detect a server restart behind a reconnect)."""
+        from ..utils.atomicfile import atomic_write_json, read_json
+        path = os.path.join(spill_dir, "epoch.json")
+        try:
+            epoch = int(read_json(path).get("epoch", 0)) + 1
+        except (OSError, ValueError):
+            epoch = 1
+        atomic_write_json(path, {"epoch": epoch})
+        return epoch
 
     # --------------------------------------------------------- fault testing
 

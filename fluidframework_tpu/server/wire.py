@@ -10,7 +10,8 @@ length-prefixed JSON over TCP with a CRC32 integrity check:
 One frame = one protocol message, a dict with ``t`` naming the kind:
 
 client → server:
-    {"t": "connect", "doc": id}                 open the delta stream
+    {"t": "connect", "doc": id, "resilient"?}   open the delta stream
+    {"t": "resync", "doc", "client_id", "from_seq"}   session resumption
     {"t": "op", "contents", "type", "ref_seq", "address"}
     {"t": "signal", "contents"}
     {"t": "deltas", "doc", "from_seq", "to_seq"}        (storage read)
@@ -18,14 +19,20 @@ client → server:
     {"t": "summary_put", "doc", "summary", "seq"}
     {"t": "disconnect"}
 server → client:
-    {"t": "connected", "client_id"}
+    {"t": "connected", "client_id", "epoch"}
     {"t": "op", "msg": <sequenced message>}     the broadcast stream
     {"t": "nack", ...}
+    {"t": "dup_ack", "doc_id", "client_seq", "seq"}   idempotent re-ack
     {"t": "signal", ...}
+    {"t": "resynced", "client_id", "epoch", "last_client_seq", "msgs"}
     {"t": "deltas_result", "msgs": [...]}
     {"t": "summary_result", "summary", "seq"}
     {"t": "summary_put_result", "handle"}
     {"t": "error", "message"}
+
+``connect`` with ``resilient: true`` marks the session as resumable: on
+socket loss the server parks the client's seat instead of sequencing a
+leave, and a later ``resync`` re-binds it (see ``drivers.resilient``).
 """
 
 from __future__ import annotations
@@ -118,12 +125,13 @@ def msg_from_wire(d: dict) -> SequencedDocumentMessage:
 
 def nack_to_wire(nack: Nack) -> dict:
     return {"doc_id": nack.doc_id, "client_id": nack.client_id,
-            "client_seq": nack.client_seq, "reason": int(nack.reason)}
+            "client_seq": nack.client_seq, "reason": int(nack.reason),
+            "seq": nack.seq}
 
 
 def nack_from_wire(d: dict) -> Nack:
     return Nack(d["doc_id"], d["client_id"], d["client_seq"],
-                NackReason(d["reason"]))
+                NackReason(d["reason"]), seq=d.get("seq", -1))
 
 
 def wait_for_port(host: str, port: int, timeout: float = 10.0) -> None:

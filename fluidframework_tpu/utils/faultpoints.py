@@ -17,8 +17,9 @@ can assert they cover it.
 
 from __future__ import annotations
 
+import random
 import threading
-from typing import Optional, Set
+from typing import Dict, Optional, Set
 
 #: every site name ever declared via :func:`declare_site` — the
 #: discoverable injection surface (drills sweep it; reviews audit it).
@@ -109,6 +110,96 @@ class armed:
     def __exit__(self, *_exc):
         uninstall()
         return False
+
+
+class ProbabilisticPlan:
+    """Repeat-fire fault plan: each armed site crashes with probability
+    ``p`` on every hit, drawn from one seeded rng so a soak run replays
+    exactly. Unlike :class:`testing.chaos.FaultPlan` (one-shot budgets:
+    "crash on the Nth hit"), this plan never exhausts — it models a
+    flaky fleet rather than a scripted kill.
+
+    ``arm(site, p)`` may be called before or after install; ``disarm``
+    removes one site. ``fires`` counts injected crashes per site so
+    drills can assert coverage.
+    """
+
+    def __init__(self, rng: Optional[random.Random] = None):
+        self.rng = rng or random.Random()
+        self._p: Dict[str, float] = {}
+        self._stall: Dict[str, tuple] = {}   # site → (p, seconds)
+        self.fires: Dict[str, int] = {}
+        self.stalls: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def arm(self, site: str, p: float = 0.01) -> "ProbabilisticPlan":
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"p must be a probability, got {p}")
+        with self._lock:
+            self._p[site] = p
+        return self
+
+    def arm_stall(self, site: str, p: float, seconds: float
+                  ) -> "ProbabilisticPlan":
+        """With probability ``p`` per hit, sleep ``seconds`` at ``site``
+        — degradation (delayed sequencing → delayed acks), not death."""
+        if not 0.0 <= p <= 1.0:
+            raise ValueError(f"p must be a probability, got {p}")
+        with self._lock:
+            self._stall[site] = (p, seconds)
+        return self
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._p.pop(site, None)
+            self._stall.pop(site, None)
+
+    def hit(self, site: str, **ctx) -> None:
+        with self._lock:
+            stall = self._stall.get(site)
+            sleep_s = 0.0
+            if stall is not None and self.rng.random() < stall[0]:
+                self.stalls[site] = self.stalls.get(site, 0) + 1
+                sleep_s = stall[1]
+            p = self._p.get(site)
+            fire = p is not None and self.rng.random() < p
+            if fire:
+                self.fires[site] = self.fires.get(site, 0) + 1
+        if sleep_s:
+            import time
+            time.sleep(sleep_s)
+        if fire:
+            raise CrashInjected(site)
+
+
+def arm(site: str, p: float = 0.01,
+        rng: Optional[random.Random] = None) -> ProbabilisticPlan:
+    """Probabilistically arm ``site``: installs a shared
+    :class:`ProbabilisticPlan` (creating one if nothing is installed,
+    reusing the installed one if it is probabilistic) and arms the site
+    at rate ``p``. A later ``rng`` replaces the plan's rng so callers
+    can re-seed between soak phases. Raises if a *different* kind of
+    plan is installed — mixing one-shot budgets with probabilistic fire
+    would make both unaccountable."""
+    global _plan
+    with _lock:
+        plan = _plan
+        if plan is None:
+            plan = ProbabilisticPlan(rng=rng)
+            _plan = plan
+        elif not isinstance(plan, ProbabilisticPlan):
+            raise RuntimeError("a non-probabilistic fault plan is installed")
+        elif rng is not None:
+            plan.rng = rng
+    return plan.arm(site, p)
+
+
+def disarm(site: str) -> None:
+    """Remove one probabilistically armed site (no-op when the installed
+    plan is not probabilistic or nothing is armed)."""
+    plan = _plan
+    if isinstance(plan, ProbabilisticPlan):
+        plan.disarm(site)
 
 
 # Core sites declared centrally (hosts may declare more):

@@ -161,15 +161,17 @@ def _op(client_seq: int, n: int) -> bytes:
 
 def test_duplicated_frame_nacked_not_resequenced(server):
     """At-least-once ingress replays a frame: Deli dedupes on clientSeq —
-    one sequenced op, one DUPLICATE nack, stream continues."""
+    one sequenced op, an idempotent dup-ack carrying the ORIGINAL seq
+    (ISSUE 9 durable dedup ledger), stream continues."""
     with _connect(server.port, "dup") as s:
         s.sendall(_op(1, 1))
         first = wire.recv_frame(s)
         assert first["t"] == "op" and first["msg"]["client_seq"] == 1
         s.sendall(_op(1, 1))  # the replay
         nack = wire.recv_frame(s)
-        assert nack["t"] == "nack"
-        assert nack["reason"] == int(NackReason.DUPLICATE)
+        assert nack["t"] == "dup_ack"
+        assert nack["client_seq"] == 1
+        assert nack["seq"] == first["msg"]["seq"]
         s.sendall(_op(2, 2))
         nxt = wire.recv_frame(s)
         assert nxt["t"] == "op"

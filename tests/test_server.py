@@ -76,9 +76,16 @@ def test_duplicate_and_gap_nacks():
     conn = svc.connect("doc")
     svc._ingest("doc", conn.client_id, 1, 0, MessageType.OP, {"n": 1}, None)
     svc._ingest("doc", conn.client_id, 1, 0, MessageType.OP, {"n": 1}, None)
-    assert svc.nacks[-1].reason == NackReason.DUPLICATE
+    # a duplicate of an already-DURABLE op is idempotently dup-acked
+    # with the original seq (ISSUE 9 durable dedup), not nacked
+    assert not svc.nacks
+    assert conn.dup_acks and conn.dup_acks[-1].client_seq == 1
+    assert conn.dup_acks[-1].seq > 0
     svc._ingest("doc", conn.client_id, 5, 0, MessageType.OP, {"n": 5}, None)
     assert svc.nacks[-1].reason == NackReason.CLIENT_SEQ_GAP
+    # the doc saw exactly one OP: the duplicate never re-applied
+    assert len([m for m in svc.get_deltas("doc", 0)
+                if m.type == MessageType.OP]) == 1
 
 
 def test_catchup_via_scriptorium():

@@ -1,0 +1,416 @@
+"""Session resilience plane (ISSUE 9): reconnect/resubmit with durable
+dedup, replica failover with oplog catch-up, torn-frame recovery, and
+the seeded chaos soak.
+
+The contract under test, end to end: **an acked op is durable and
+applied exactly once — across socket kills, torn frames, injected
+sequencer crashes, and whole-service crash-restarts — and an un-acked op
+may be dropped but never corrupts.**
+"""
+
+import importlib.util
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.core.protocol import MessageType
+from fluidframework_tpu.drivers.resilient import (
+    ResilientColumnarClient, ResilientConnection,
+)
+from fluidframework_tpu.server import native_deli
+from fluidframework_tpu.server.ingress import AlfredServer
+from fluidframework_tpu.server.tinylicious import LocalService
+from fluidframework_tpu.utils.backoff import Backoff, retry
+from fluidframework_tpu.utils.faultpoints import (
+    SITE_DELI_MID_WINDOW, CrashInjected, ProbabilisticPlan, armed,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tool(name):
+    """Load a tools/*.py script as a module (tools/ is not a package)."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------- backoff util
+
+
+class TestBackoff:
+    def test_decorrelated_jitter_bounded_and_seeded(self):
+        a = Backoff(base=0.01, cap=0.5, rng=random.Random(3))
+        b = Backoff(base=0.01, cap=0.5, rng=random.Random(3))
+        da = [a.next_delay() for _ in range(20)]
+        db = [b.next_delay() for _ in range(20)]
+        assert da == db                      # same seed, same schedule
+        assert all(0.01 <= d <= 0.5 for d in da)
+        a.reset()
+        assert a.next_delay() <= 0.03        # reset forgets the growth
+
+    def test_retry_retries_then_succeeds(self):
+        calls = []
+        slept = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        bo = Backoff(base=0.01, cap=0.1, rng=random.Random(0))
+        assert retry(flaky, attempts=5, backoff=bo,
+                     sleep=slept.append) == "ok"
+        assert len(calls) == 3 and len(slept) == 2
+
+    def test_retry_exhausts(self):
+        def dead():
+            raise OSError("forever")
+
+        with pytest.raises(OSError):
+            retry(dead, attempts=3,
+                  backoff=Backoff(base=0.001, rng=random.Random(0)),
+                  sleep=lambda _s: None)
+
+
+# ------------------------------------------------- probabilistic faultpoints
+
+
+class TestProbabilisticFaultpoints:
+    def _drive(self, seed, hits=200, p=0.05):
+        plan = ProbabilisticPlan(rng=random.Random(seed))
+        plan.arm("t.site", p)
+        trace = []
+        for i in range(hits):
+            try:
+                plan.hit("t.site")
+                trace.append(0)
+            except CrashInjected:
+                trace.append(1)
+        return plan, trace
+
+    def test_seeded_fire_schedule_replays(self):
+        p1, t1 = self._drive(11)
+        p2, t2 = self._drive(11)
+        assert t1 == t2 and sum(t1) == p1.fires["t.site"] > 0
+
+    def test_stall_arm_counts_without_killing(self):
+        plan = ProbabilisticPlan(rng=random.Random(5))
+        plan.arm_stall("t.stall", p=1.0, seconds=0.0)
+        for _ in range(7):
+            plan.hit("t.stall")              # never raises
+        assert plan.stalls["t.stall"] == 7 and not plan.fires
+
+    def test_armed_context_uninstalls_on_crash(self):
+        from fluidframework_tpu.utils import faultpoints as fp
+        plan = ProbabilisticPlan(rng=random.Random(1)).arm("t.die", 1.0)
+        with pytest.raises(CrashInjected):
+            with armed(plan):
+                fp.fault_point("t.die")
+        assert fp.active_plan() is None
+
+
+# -------------------------------------------------------- JSON front door
+
+
+def _drain_all(conns, timeout=20.0):
+    for c in conns:
+        assert c.wait_idle(timeout=timeout), (
+            c.doc_id, c.pending_count, c.reconnects)
+        assert not c.nacks, c.nacks
+
+
+def _durable_ops(svc, doc):
+    return [m for m in svc.get_deltas(doc, 0)
+            if m.type == MessageType.OP]
+
+
+class TestJsonReconnect:
+    def test_socket_kill_resubmits_exactly_once(self):
+        svc = LocalService(n_partitions=2)
+        server = AlfredServer(svc).start_in_thread()
+        try:
+            conn = ResilientConnection("127.0.0.1", server.port, "d0",
+                                       rng=random.Random(0))
+            uids = []
+            for i in range(10):
+                uids.append(conn.submit({"mt": "insert", "kind": 0,
+                                         "pos": 0, "text": f"x{i}",
+                                         "u": i}))
+                if i == 4:
+                    conn.kill_socket()
+            _drain_all([conn])
+            assert conn.reconnects >= 1
+            durable = _durable_ops(svc, "d0")
+            markers = [m.contents["u"] for m in durable]
+            assert markers == list(range(10))        # order, exactly once
+            assert {conn.op_acks[u] for u in uids} == \
+                {m.seq for m in durable}
+            conn.close()
+        finally:
+            server.stop()
+            svc.close()
+
+    def test_crash_restart_rides_through(self, tmp_path):
+        """The whole service dies mid-session and recovers from its
+        spill on the same port; the client resyncs against the new
+        epoch and every op lands exactly once."""
+        spill = str(tmp_path)
+        svc = LocalService(n_partitions=2, spill_dir=spill)
+        server = AlfredServer(svc).start_in_thread()
+        port = server.port
+        conn = ResilientConnection("127.0.0.1", port, "d0",
+                                   rng=random.Random(1), attempts=12)
+        try:
+            for i in range(5):
+                conn.submit({"mt": "insert", "kind": 0, "pos": 0,
+                             "text": "a", "u": i})
+            assert conn.wait_idle(timeout=10)
+            epoch0 = conn.epoch
+            server.stop()
+            svc.close()
+            # in-flight ops against a dead server: tracked, not lost
+            for i in range(5, 8):
+                conn.submit({"mt": "insert", "kind": 0, "pos": 0,
+                             "text": "b", "u": i})
+            svc = LocalService.recover(spill, n_partitions=2)
+            server = AlfredServer(svc, port=port).start_in_thread()
+            _drain_all([conn])
+            assert conn.epoch > epoch0
+            markers = [m.contents["u"] for m in _durable_ops(svc, "d0")]
+            assert markers == list(range(8))
+            conn.close()
+        finally:
+            server.stop()
+            svc.close()
+
+    def test_recover_dup_acks_resubmit_with_original_seq(self, tmp_path):
+        """Durable dedup across restart, at the service layer: a resubmit
+        of an already-durable clientSeq is acked idempotently with the
+        ORIGINAL seq and never re-applied."""
+        spill = str(tmp_path)
+        svc = LocalService(n_partitions=2, spill_dir=spill)
+        conn = svc.connect("docA")
+        cid = conn.client_id
+        for i in range(1, 4):
+            conn.submit_raw(i, {"u": i}, MessageType.OP, 0)
+        orig = {m.client_seq: m.seq for m in _durable_ops(svc, "docA")}
+        svc.close()
+
+        svc2 = LocalService.recover(spill, n_partitions=2)
+        try:
+            assert svc2.last_client_seq("docA", cid) == 3
+            conn2 = svc2.reconnect("docA", cid)
+            for i in range(1, 4):
+                conn2.submit_raw(i, {"u": i}, MessageType.OP, 0)
+            assert [(d.client_seq, d.seq) for d in conn2.dup_acks] == \
+                sorted(orig.items())
+            assert len(_durable_ops(svc2, "docA")) == 3   # no re-apply
+            # the seat still sequences fresh ops
+            conn2.submit_raw(4, {"u": 4}, MessageType.OP, 0)
+            assert len(_durable_ops(svc2, "docA")) == 4
+        finally:
+            svc2.close()
+
+
+# ------------------------------------------------------ columnar front door
+
+needs_native = pytest.mark.skipif(not native_deli.available(),
+                                  reason="native sequencer unavailable")
+
+
+def _mk_columnar(n_docs=8, window_min_rows=1, window_ms=2.0):
+    from fluidframework_tpu.server.columnar_ingress import ColumnarAlfred
+    from fluidframework_tpu.server.serving import StringServingEngine
+    eng = StringServingEngine(n_docs=n_docs, capacity=256,
+                              batch_window=10 ** 9, sequencer="native")
+    srv = ColumnarAlfred(eng, window_min_rows=window_min_rows,
+                         window_ms=window_ms).start_in_thread()
+    return eng, srv
+
+
+@needs_native
+class TestColumnarReconnect:
+    def test_kill_rejoin_keeps_identity_and_dedups(self):
+        eng, srv = _mk_columnar()
+        try:
+            cl = ResilientColumnarClient("127.0.0.1", srv.port, ["d0"],
+                                         rng=random.Random(2))
+            cid = cl.client_id
+            for i in range(6):
+                cl.submit("d0", kind=0, a0=0, payload=f"w{i}.")
+                if i == 2:
+                    cl.kill_socket()
+            assert cl.wait_idle(timeout=10), cl.pending_count
+            assert cl.client_id == cid and cl.reconnects >= 1
+            assert sorted(cl.acks["d0"]) == list(range(1, 7))
+            text = eng.read_text("d0")
+            for i in range(6):
+                assert text.count(f"w{i}.") == 1, (i, text)
+            cl.close()
+        finally:
+            srv.stop()
+
+    def test_rejoin_reports_dedup_cursor(self):
+        """`joined` carries last-accepted clientSeq per doc so a resumed
+        client can renumber/skip without probing."""
+        eng, srv = _mk_columnar()
+        try:
+            cl = ResilientColumnarClient("127.0.0.1", srv.port,
+                                         ["a", "b"],
+                                         rng=random.Random(3))
+            cl.submit("a", kind=0, a0=0, payload="x.")
+            cl.submit("a", kind=0, a0=0, payload="y.")
+            cl.submit("b", kind=0, a0=0, payload="z.")
+            assert cl.wait_idle(timeout=10)
+            cl.kill_socket()
+            deadline = time.monotonic() + 10
+            while cl.reconnects < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert cl.lcs.get("a") == 2 and cl.lcs.get("b") == 1, cl.lcs
+            cl.close()
+        finally:
+            srv.stop()
+
+
+@needs_native
+class TestTornFrames:
+    """A connection dying mid-frame must never sequence a partial
+    window, and a resilient client recovers the op on reconnect."""
+
+    def _torn(self, cut):
+        import socket as socklib
+
+        from fluidframework_tpu.server import columnar_ingress as colwire
+        import numpy as np
+        eng, srv = _mk_columnar()
+        try:
+            cl = ResilientColumnarClient("127.0.0.1", srv.port, ["d0"],
+                                         rng=random.Random(4))
+            cl.submit("d0", kind=0, a0=0, payload="pre.")
+            assert cl.wait_idle(timeout=10)
+            # a second, torn submission: register it pending as submit()
+            # would, but write only a prefix of its frame before the
+            # socket dies (the frame layout: 5B header | payload | crc)
+            ops = np.zeros(1, dtype=colwire._OP_DTYPE)
+            ops["row"] = cl.rows["d0"]
+            ops["cseq"] = 2
+            frame = colwire.encode_op_batch(["torn."], ops)
+            with cl._lock:
+                cl._cseq["d0"] = 2
+                cl._pending["d0"][2] = (0, 0, 0, "torn.", 0)
+                sock = cl._sock
+            sock.sendall(frame[:cut])
+            cl.kill_socket()
+            # reconnect resubmits the torn op; exactly one copy lands
+            assert cl.wait_idle(timeout=10), cl.pending_count
+            text = eng.read_text("d0")
+            assert text.count("torn.") == 1, text
+            assert text.count("pre.") == 1, text
+            # the server survived the tear: a fresh op still flows
+            cl.submit("d0", kind=0, a0=0, payload="post.")
+            assert cl.wait_idle(timeout=10)
+            assert eng.read_text("d0").count("post.") == 1
+            cl.close()
+        finally:
+            srv.stop()
+
+    def test_killed_mid_length_prefix(self):
+        self._torn(cut=3)       # inside the 5-byte type+length header
+
+    def test_killed_mid_payload(self):
+        self._torn(cut=12)      # header complete, payload truncated
+
+
+# ------------------------------------------------------------ failover
+
+
+@needs_native
+class TestFailover:
+    def test_follower_promotion_digest_parity(self):
+        from fluidframework_tpu.parallel.replicated import OplogFollower
+        from fluidframework_tpu.server.oplog import PartitionedLog
+        from fluidframework_tpu.testing.chaos import (
+            OpGen, digest, make_engine,
+        )
+        rng = random.Random(6)
+        docs = [f"doc{i}" for i in range(3)]
+        leader = make_engine("string", log=PartitionedLog(2))
+        for d in docs:
+            leader.connect(d, 1)
+        follower = OplogFollower(leader, family="string")
+        gen = OpGen(rng, "string", docs)
+        cseq = {d: 0 for d in docs}
+        for i in range(60):
+            d = rng.choice(docs)
+            cseq[d] += 1
+            leader.submit(d, 1, cseq[d], 0, gen.op(d))
+            if i == 30:
+                follower.catch_up()     # trailing mid-stream is fine
+        leader.flush()
+        expected = digest(leader, "string", docs)
+        # the leader "dies"; the durable log is all that remains
+        promoted = follower.promote()
+        assert follower.promoted
+        assert digest(promoted, "string", docs) == expected
+        # the new leader sequences fresh traffic on the same seats
+        d = docs[0]
+        cseq[d] += 1
+        msg, nack = promoted.submit(d, 1, cseq[d], 0,
+                                    {"mt": "insert", "kind": 0,
+                                     "pos": 0, "text": "after."})
+        assert nack is None and msg.seq > 0
+        assert promoted.read_text(d).count("after.") == 1
+        # dedup continuity: resubmitting a pre-failover cseq dup-acks
+        msg2, nack2 = promoted.submit(d, 1, 1, 0, {"mt": "insert",
+                                                   "kind": 0, "pos": 0,
+                                                   "text": "dup."})
+        assert nack2 is not None and nack2.seq > 0
+        assert "dup." not in promoted.read_text(d)
+
+
+# ------------------------------------------------------------- chaos soak
+
+
+@pytest.mark.soak
+class TestChaosSoak:
+    def test_quick_seeded_soak_holds_invariants(self):
+        chaos_soak = _tool("chaos_soak")
+        report = chaos_soak.run_soak(seed=7, steps=150, n_clients=3,
+                                     restarts=3, kill_p=0.02,
+                                     crash_p=0.01)
+        assert report["violations"] == 0
+        assert report["ops_acked"] == report["ops_submitted"] == 150
+        assert report["restarts"] == 3       # the acceptance's >=3 bar
+        assert report["reconnects"] >= 3     # every restart forces some
+        assert report["final_epoch"] >= 3
+
+    def test_soak_audit_catches_seeded_corruption(self, tmp_path):
+        """The auditor itself is load-bearing: feed it a stream with a
+        doctored ack map and it must raise, not pass vacuously."""
+        chaos_soak = _tool("chaos_soak")
+        svc = LocalService(n_partitions=1, spill_dir=str(tmp_path))
+        server = AlfredServer(svc).start_in_thread()
+        try:
+            conn = ResilientConnection("127.0.0.1", server.port, "d0",
+                                       rng=random.Random(8))
+            uids = [conn.submit({"u": f"d0:{i}"}) for i in range(3)]
+            assert conn.wait_idle(timeout=10)
+            good = {"d0": [f"d0:{i}" for i in range(3)]}
+            uid_marker = {"d0": {u: f"d0:{i}"
+                                 for i, u in enumerate(uids)}}
+            chaos_soak._audit(svc, [conn], good, uid_marker)   # clean
+            conn.op_acks[uids[1]] += 7       # corrupt one acked seq
+            with pytest.raises(chaos_soak.SoakViolation,
+                               match="ack_seq_mismatch"):
+                chaos_soak._audit(svc, [conn], good, uid_marker)
+            conn.close()
+        finally:
+            server.stop()
+            svc.close()

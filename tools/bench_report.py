@@ -184,6 +184,21 @@ def ingress_block(rec: dict) -> str | None:
     return json.dumps(out)
 
 
+def reconnect_storm_block(rec: dict) -> str | None:
+    """Reconnect-storm fenced block (ISSUE 9: resilience under load), or
+    None on records predating the phase."""
+    storm = rec.get("reconnect_storm")
+    if not isinstance(storm, dict):
+        return None
+    out = {"metric": "reconnect_storm_ops_per_sec", "unit": "ops/s"}
+    out.update({k: storm[k] for k in (
+        "ops_per_sec", "ops_acked", "reconnects", "reconnect_p50_ms",
+        "reconnect_p99_ms", "resubmits", "dup_acked", "socket_kills",
+        "restarts", "faultpoint_fires", "invariant_violations",
+        "error") if k in storm})
+    return json.dumps(out)
+
+
 _FENCE_RE = re.compile(r"```json\n.*?\n```", re.S)
 
 
@@ -219,7 +234,9 @@ def regenerate(root: Path, json_path: Path | None = None,
     # them (older rounds predate the matrix/ingress phases)
     for heading, extra in (("## Matrix serving", matrix_block(rec)),
                            ("## Tree serving", tree_block(rec)),
-                           ("## Columnar ingress", ingress_block(rec))):
+                           ("## Columnar ingress", ingress_block(rec)),
+                           ("## Reconnect storm",
+                            reconnect_storm_block(rec))):
         if extra is not None:
             updated = update_section(updated, heading, extra)
     if write:

@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Randomized chaos soak over the resilience plane (ISSUE 9 tentpole).
+
+One seeded run drives the full session-resilience story end to end:
+
+- a real ingress service (``AlfredServer`` over a ``LocalService`` with a
+  JSONL spill) on a fixed port,
+- several :class:`drivers.resilient.ResilientConnection` clients — one
+  doc each (single-writer), mixed op families (string / tree / matrix
+  contents from ``testing.chaos.OpGen``), every op's contents stamped
+  with a unique marker,
+- a randomized fault scheduler, all draws from ONE seeded rng so a run
+  replays exactly:
+
+  * **connection kills** — a random client's socket is hard-closed
+    mid-traffic (the reconnect/resubmit path),
+  * **process crash-restarts** — the server thread is torn down, the
+    service recovered from its spill (``LocalService.recover``) and
+    re-served on the SAME port (the durable-dedup + resync-renumber
+    path; every client rides across the restart),
+  * **probabilistic faultpoints** — ``deli.sequence.mid_window`` armed
+    with a small crash probability (burned clientSeqs) and a stall
+    probability (delayed acks) via
+    :class:`utils.faultpoints.ProbabilisticPlan`.
+
+After the storm every client drains (``wait_idle``) and the durable
+deltas stream is audited against each client's own ledger:
+
+1. **exactly-once**: every acked op's marker appears in the durable
+   stream exactly once, at exactly the seq the ack reported — a lost
+   acked op or a double-applied resubmit both fail here;
+2. **no strays**: the durable op set equals the acked set (single-writer
+   docs + full drain ⇒ nothing else may appear);
+3. **order**: per doc, seqs are strictly increasing and the marker
+   sequence equals the client's submission order — the same digest a
+   fault-free run produces, which is the digest-parity acceptance check
+   without needing a second run;
+4. **monotone seq space**: no seq is ever reused across the restarts.
+
+The first violation increments ``soak_invariant_violations_total``,
+notes + dumps the flight recorder (``chaos_soak``), and raises
+:class:`SoakViolation` with the evidence. A clean run returns a report
+dict (ops, acks, reconnects, resubmits, dup-acks, restarts, faultpoint
+fires/stalls, per-doc digests).
+
+Usage::
+
+    python tools/chaos_soak.py --seed 7 --steps 400 --clients 4
+    python tools/chaos_soak.py --seed 7 --quick      # the tier-1 profile
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from fluidframework_tpu.core.protocol import MessageType          # noqa: E402
+from fluidframework_tpu.drivers.resilient import ResilientConnection  # noqa: E402,E501
+from fluidframework_tpu.server.ingress import AlfredServer        # noqa: E402
+from fluidframework_tpu.server.tinylicious import LocalService    # noqa: E402
+from fluidframework_tpu.testing.chaos import OpGen                # noqa: E402
+from fluidframework_tpu.utils import flight_recorder              # noqa: E402
+from fluidframework_tpu.utils.faultpoints import (                # noqa: E402
+    SITE_DELI_MID_WINDOW, ProbabilisticPlan, armed,
+)
+from fluidframework_tpu.utils.telemetry import REGISTRY           # noqa: E402
+
+#: op families cycled across the soak's clients
+FAMILIES = ("string", "tree", "matrix")
+
+
+class SoakViolation(AssertionError):
+    """An invariant the resilience plane guarantees was broken."""
+
+
+def _violate(kind: str, **evidence) -> None:
+    REGISTRY.inc("soak_invariant_violations_total")
+    flight_recorder.note("soak_invariant_violation", kind=kind,
+                         **{k: v for k, v in evidence.items()
+                            if isinstance(v, (int, float, str, bool))})
+    try:
+        flight_recorder.dump("chaos_soak", extra={"kind": kind})
+    except OSError:
+        pass
+    raise SoakViolation(f"{kind}: {evidence}")
+
+
+class _Cluster:
+    """The server side of the soak: one LocalService + AlfredServer on a
+    fixed port, restartable in place (crash + recover-from-spill)."""
+
+    def __init__(self, spill_dir: str, n_partitions: int = 2):
+        self.spill_dir = spill_dir
+        self.n_partitions = n_partitions
+        self.service = LocalService(n_partitions=n_partitions,
+                                    spill_dir=spill_dir)
+        self.server = AlfredServer(self.service).start_in_thread()
+        self.port = self.server.port
+        self.restarts = 0
+
+    def crash_restart(self) -> None:
+        """Kill the serving process (thread) without any shutdown
+        courtesy, then recover the service from its spill and re-serve
+        on the same port — what a supervisor restart looks like to the
+        clients (dead sockets, then a resync against a higher epoch)."""
+        self.server.stop()
+        self.service.close()
+        self.service = LocalService.recover(
+            self.spill_dir, n_partitions=self.n_partitions)
+        self.server = AlfredServer(
+            self.service, port=self.port).start_in_thread()
+        self.restarts += 1
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.service.close()
+
+
+def run_soak(seed: int = 0, steps: int = 400, n_clients: int = 4,
+             kill_p: float = 0.01, restarts: int = 3,
+             crash_p: float = 0.002, stall_p: float = 0.01,
+             stall_s: float = 0.005, spill_dir: Optional[str] = None,
+             idle_timeout: float = 30.0) -> dict:
+    """Run one seeded soak; returns the report dict or raises
+    :class:`SoakViolation` / :class:`TimeoutError`."""
+    rng = random.Random(seed)
+    tmp = None
+    if spill_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="chaos_soak_")
+        spill_dir = tmp.name
+    cluster = _Cluster(spill_dir)
+    # restart schedule: distinct step indices drawn up front so the
+    # run is replayable and the restart count is exact, not expected
+    restart_at = set(rng.sample(range(steps // 4, steps),
+                                min(restarts, max(1, steps - steps // 4))))
+    plan = ProbabilisticPlan(rng=random.Random(rng.randrange(2**31)))
+    plan.arm(SITE_DELI_MID_WINDOW, crash_p)
+    plan.arm_stall(SITE_DELI_MID_WINDOW, stall_p, stall_s)
+
+    clients: List[ResilientConnection] = []
+    gens: Dict[str, OpGen] = {}
+    submitted: Dict[str, List[str]] = {}     # doc → markers, in order
+    uid_marker: Dict[str, Dict[int, str]] = {}   # doc → uid → marker
+    t0 = time.perf_counter()
+    kills = 0
+    try:
+        with armed(plan):
+            for i in range(n_clients):
+                doc = f"soak-{i}"
+                fam = FAMILIES[i % len(FAMILIES)]
+                gens[doc] = OpGen(random.Random(rng.randrange(2**31)),
+                                  fam, [doc])
+                submitted[doc] = []
+                uid_marker[doc] = {}
+                clients.append(ResilientConnection(
+                    "127.0.0.1", cluster.port, doc,
+                    rng=random.Random(rng.randrange(2**31)),
+                    attempts=12))
+            for step in range(steps):
+                ci = rng.randrange(n_clients)
+                conn = clients[ci]
+                doc = conn.doc_id
+                marker = f"{doc}:{step}"
+                op = dict(gens[doc].op(doc), u=marker)
+                uid = conn.submit(op)
+                submitted[doc].append(marker)
+                uid_marker[doc][uid] = marker
+                if rng.random() < kill_p:
+                    kills += 1
+                    clients[rng.randrange(n_clients)].kill_socket()
+                if step in restart_at:
+                    # let in-flight traffic settle a beat so the restart
+                    # catches a mix of durable and in-flight ops
+                    time.sleep(0.02)
+                    cluster.crash_restart()
+            # drain: every submitted op must end acked (resubmission
+            # across kills/restarts is the plane under test)
+            for conn in clients:
+                if not conn.wait_idle(timeout=idle_timeout):
+                    _violate("drain_timeout", doc=conn.doc_id,
+                             pending=conn.pending_count,
+                             reconnects=conn.reconnects)
+                if conn.nacks:
+                    _violate("genuine_nack", doc=conn.doc_id,
+                             n=len(conn.nacks))
+        _audit(cluster.service, clients, submitted, uid_marker)
+        lat = sorted(t for c in clients for t in c.reconnect_latencies)
+        report = {
+            "seed": seed, "steps": steps, "clients": n_clients,
+            "ops_submitted": sum(len(v) for v in submitted.values()),
+            "ops_acked": sum(len(c.op_acks) for c in clients),
+            "reconnects": sum(c.reconnects for c in clients),
+            "resubmits": sum(c.resubmits for c in clients),
+            "dup_acked": sum(c.dup_acked for c in clients),
+            "socket_kills": kills,
+            "restarts": cluster.restarts,
+            "faultpoint_fires": sum(plan.fires.values()),
+            "faultpoint_stalls": sum(plan.stalls.values()),
+            "final_epoch": max(c.epoch for c in clients),
+            "violations": 0,
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+            "reconnect_p50_ms": round(
+                lat[len(lat) // 2] * 1000, 2) if lat else 0.0,
+            "reconnect_p99_ms": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1000,
+                2) if lat else 0.0,
+            "digest": {d: len(v) for d, v in submitted.items()},
+        }
+        return report
+    finally:
+        for conn in clients:
+            conn.close()
+        cluster.stop()
+        if tmp is not None:
+            tmp.cleanup()
+
+
+def _audit(service: LocalService, clients, submitted, uid_marker) -> None:
+    """Audit the durable stream against every client's own ledger."""
+    for conn in clients:
+        doc = conn.doc_id
+        durable = [m for m in service.get_deltas(doc, 0)
+                   if m.type == MessageType.OP]
+        seqs = [m.seq for m in durable]
+        if any(b <= a for a, b in zip(seqs, seqs[1:])):
+            _violate("seq_not_monotone", doc=doc)
+        markers = [(m.contents or {}).get("u") for m in durable]
+        if len(set(markers)) != len(markers):
+            dup = sorted(m for m in set(markers)
+                         if markers.count(m) > 1)[0]
+            _violate("double_applied", doc=doc, marker=str(dup))
+        acked = {uid_marker[doc][uid]: seq
+                 for uid, seq in conn.op_acks.items()}
+        for m, seq in zip(markers, seqs):
+            if m not in acked:
+                _violate("stray_unacked_op", doc=doc, marker=str(m))
+            if acked[m] != seq:
+                _violate("ack_seq_mismatch", doc=doc, marker=str(m),
+                         acked_seq=acked[m], durable_seq=seq)
+        lost = sorted(set(acked) - set(markers))
+        if lost:
+            _violate("lost_acked_op", doc=doc, marker=lost[0],
+                     n_lost=len(lost))
+        # fault-free digest parity: single-writer doc + full drain ⇒ the
+        # durable marker sequence IS the submission order
+        if markers != submitted[doc]:
+            _violate("order_divergence", doc=doc,
+                     durable=len(markers), expected=len(submitted[doc]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(
+        description="randomized resilience soak (see module docstring)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--restarts", type=int, default=3)
+    ap.add_argument("--kill-p", type=float, default=0.01)
+    ap.add_argument("--crash-p", type=float, default=0.002)
+    ap.add_argument("--quick", action="store_true",
+                    help="tier-1 profile: small, seeded, ~seconds")
+    args = ap.parse_args()
+    if args.quick:
+        args.steps, args.clients, args.restarts = 150, 3, 3
+    report = run_soak(seed=args.seed, steps=args.steps,
+                      n_clients=args.clients, restarts=args.restarts,
+                      kill_p=args.kill_p, crash_p=args.crash_p)
+    print(json.dumps(report, indent=2, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()

@@ -240,6 +240,30 @@ def judge_floors(rounds: List[dict]) -> List[dict]:
     return out
 
 
+def judge_resilience(rounds: List[dict]) -> List[dict]:
+    """Hard gate on the newest round's reconnect-storm phase (ISSUE 9):
+    ``invariant_violations`` is a correctness count, not a perf number —
+    any nonzero value (or a storm that errored out, recorded as −1)
+    regresses regardless of bands or history. Rounds predating the
+    phase produce no verdict."""
+    if not rounds:
+        return []
+    storm = rounds[-1].get("reconnect_storm")
+    if not isinstance(storm, dict):
+        return []
+    v = storm.get("invariant_violations")
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return []
+    ok = v == 0
+    return [{"metric": "reconnect_storm.invariant_violations",
+             "verdict": FLAT if ok else REGRESS, "value": v,
+             "expected": "0 (resilience invariant)", "delta_pct": None,
+             "note": "acked ops exactly-once under the storm" if ok
+             else ("storm errored" if v < 0
+                   else "resilience invariant broken — see "
+                        "docs/RESILIENCE.md")}]
+
+
 def has_regression(verdicts: List[dict]) -> bool:
     return any(v["verdict"] == REGRESS for v in verdicts)
 
@@ -334,6 +358,7 @@ def main(argv=None) -> int:
     verdicts = judge(rounds, rel_band=args.rel_band,
                      k_sigma=args.k_sigma)
     verdicts += judge_floors(rounds)
+    verdicts += judge_resilience(rounds)
     failed = has_regression(verdicts)
     if args.json:
         print(json.dumps(verdicts, indent=2))
