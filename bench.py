@@ -1439,6 +1439,91 @@ def run():
     except Exception as e:   # noqa: BLE001 — the record must still emit
         reconnect_storm = {"error": repr(e), "invariant_violations": -1}
 
+    # ------------------------------------------------------- durability
+    # the recovery ladder under the clock (ISSUE 10): summary load + tail
+    # replay timed at ladder depth 0 (newest generation verifies) and
+    # depth 1 (newest rotted → fall back a rung, replay a longer tail),
+    # then an offline scrub of the phase's own spill — chain_breaks is
+    # the integrity count the perf sentinel hard-gates on
+    _phase("durability")
+    try:
+        import random as _random
+        import tempfile as _tempfile
+        from fluidframework_tpu.runtime.summarizer import (
+            SummaryGenerationStore as _GenStore,
+        )
+        from fluidframework_tpu.server.oplog import PartitionedLog as _PLog
+        from fluidframework_tpu.server.serving import (
+            StringServingEngine as _StrEngine,
+        )
+        from fluidframework_tpu.utils.faultpoints import (
+            corrupt_bitflip as _corrupt_bitflip,
+        )
+        import importlib.util as _ilu2
+        _spec2 = _ilu2.spec_from_file_location(
+            "log_scrub", _os.path.join(
+                _os.path.dirname(_os.path.abspath(__file__)),
+                "tools", "log_scrub.py"))
+        _scrub = _ilu2.module_from_spec(_spec2)
+        _spec2.loader.exec_module(_scrub)
+        with _tempfile.TemporaryDirectory(prefix="bench_dur_") as _dd:
+            _spill = _os.path.join(_dd, "spill")
+            _gen_dir = _os.path.join(_dd, "gens")
+            _os.mkdir(_spill)
+            _dlog = _PLog(2, _spill, "deltas")
+            _deng = _StrEngine(n_docs=4, capacity=1024, batch_window=16,
+                               n_partitions=2, log=_dlog)
+            _store = _GenStore(_gen_dir, keep=3)
+            _deng.connect("bench-doc", 1)
+            _n_dur = 512
+            _seq = 0
+            for _i in range(_n_dur):
+                _m, _nk = _deng.submit(
+                    "bench-doc", 1, _i + 1, 0,
+                    {"mt": "insert", "kind": 0, "pos": 0, "text": "x"})
+                _seq = _m.seq
+                # two generations: mid-run and at 3/4 — depth 1 falls
+                # back to the older one and replays the longer tail
+                if _i in (_n_dur // 2 - 1, _n_dur * 3 // 4 - 1):
+                    _deng.flush()
+                    _store.save(_deng.summarize(), _seq)
+            _deng.flush()
+            _dlog.close()
+
+            def _ladder_trial():
+                _t0 = time.perf_counter()
+                _s, _sq, _depth = _store.load_latest()
+                _rlog = _PLog.recover(2, _spill, "deltas")
+                _e2 = _StrEngine.load(_s, _rlog)
+                _e2.flush()
+                _dt = (time.perf_counter() - _t0) * 1000
+                _rlog.close()
+                return _dt, _depth
+
+            _trials0 = [_ladder_trial() for _ in range(5)]
+            # scrub the spill while it is pristine: the ladder trials are
+            # read-only, so any break here is a writer-path bug
+            _dsum = _scrub.summarize_reports(_scrub.scrub_tree(_spill))
+            _gens = _store.generations()
+            _corrupt_bitflip(
+                _os.path.join(_gen_dir, _store._BLOB.format(_gens[-1])),
+                _random.Random(17))
+            _trials1 = [_ladder_trial() for _ in range(5)]
+            _p50 = lambda ts: sorted(t for t, _ in ts)[len(ts) // 2]  # noqa: E731,E501
+            durability = {
+                "recovery_ladder_ms": {
+                    "depth0_p50": round(_p50(_trials0), 2),
+                    "depth1_p50": round(_p50(_trials1), 2),
+                },
+                "ladder_depths": [_trials0[0][1], _trials1[0][1]],
+                "ops_replayed": _n_dur,
+                "generations_kept": len(_gens),
+                "chain_breaks": _dsum["chain_breaks"],
+                "records_scrubbed": _dsum["records"],
+            }
+    except Exception as e:   # noqa: BLE001 — the record must still emit
+        durability = {"error": repr(e), "chain_breaks": -1}
+
     # observability ride-along: the unified registry's process-wide view
     # (device dispatches, jit compiles vs cache hits, oplog appends, ...)
     # plus ONE sampled span timeline from the run's newest trace, so a
@@ -1548,6 +1633,10 @@ def run():
         # throughput/latency plus the invariant-violation count the
         # perf sentinel gates on
         "reconnect_storm": reconnect_storm,
+        # durable-layer integrity under the clock (ISSUE 10): recovery
+        # ladder p50 at depth 0/1 + the scrub's chain-break count the
+        # perf sentinel hard-gates on
+        "durability": durability,
         # continuous canary, attributed per phase: worst in-phase RTT +
         # contended flag (samples taken DURING the phase, not only at
         # its boundaries)

@@ -197,15 +197,23 @@ class OplogFollower:
         return n
 
     def promote(self):
-        """Final catch-up from the (dead) leader's durable log, then hand
-        the engine over as the new authority."""
+        """Fence the deposed leader, final catch-up from its durable log,
+        then hand the engine over as the new authority.
+
+        Order matters (ISSUE 10): the fence bump comes FIRST, so a
+        not-actually-dead leader cannot land an append after the final
+        catch-up read — anything it tries past this point raises
+        ``FencedWriterError`` instead of silently extending a stream the
+        follower already took over."""
         from ..utils import flight_recorder, telemetry
+        new_epoch = self.engine.acquire_write_authority()
         n = self.catch_up()
         self.promoted = True
         telemetry.REGISTRY.inc("failover_promotions_total")
         flight_recorder.note("failover_promotion", family=self.family,
                              final_catchup_ops=n,
-                             total_ops=self.caught_up_ops)
+                             total_ops=self.caught_up_ops,
+                             epoch=-1 if new_epoch is None else new_epoch)
         return self.engine
 
 

@@ -31,13 +31,169 @@ star; SURVEY.md §7.7).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import os
+import pickle
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional, Tuple
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..utils import tracing
 from ..utils.faultpoints import SITE_SUMMARIZER_POST_UPLOAD, fault_point
 from ..utils.telemetry import REGISTRY
+
+
+class SummaryIntegrityError(RuntimeError):
+    """No summary generation survived manifest verification — the ladder
+    ran out of rungs (recovery must fall back to full-log replay)."""
+
+
+class SummaryGenerationStore:
+    """Multi-generation summary store with hashed manifests — the
+    recovery ladder (ISSUE 10).
+
+    Each ``save()`` writes one GENERATION: the summary blob (pickle —
+    summaries carry numpy planes that JSON cannot round-trip losslessly)
+    plus a small JSON manifest recording the blob's SHA-256, size, base
+    seq, and generation number. The last ``keep`` generations are
+    retained; older ones are pruned.
+
+    ``load_latest()`` is the ladder: walk generations newest → oldest,
+    verify each blob against its manifest BEFORE unpickling (a corrupt
+    blob is never deserialized), and return the first generation that
+    verifies, together with its ladder DEPTH (0 = newest). A deeper rung
+    means an older summary — recovery still converges because the log
+    tail replay is correspondingly longer (the summary's ``log_offsets``
+    are older). Emits the ``recovery_ladder_depth`` gauge and counts
+    ``summary_manifest_verify_failures_total`` per rejected rung; raises
+    :class:`SummaryIntegrityError` when every rung fails.
+    """
+
+    _BLOB = "gen-{:08d}.summary.pkl"
+    _MANIFEST = "gen-{:08d}.manifest.json"
+
+    def __init__(self, directory: str, keep: int = 3):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # ------------------------------------------------------------- save
+    def generations(self) -> List[int]:
+        """Generation numbers with a manifest on disk, ascending."""
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("gen-") and name.endswith(".manifest.json"):
+                try:
+                    out.append(int(name[4:12]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def save(self, summary: dict, seq: int) -> int:
+        """Persist one generation (blob first, manifest last — a crash
+        between the two leaves a manifest-less blob the ladder ignores).
+        Returns the generation number."""
+        gens = self.generations()
+        gen = (gens[-1] + 1) if gens else 0
+        blob = pickle.dumps(summary, protocol=pickle.HIGHEST_PROTOCOL)
+        blob_path = os.path.join(self.directory, self._BLOB.format(gen))
+        tmp = blob_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, blob_path)
+        manifest = {"generation": gen, "seq": int(seq),
+                    "sha256": hashlib.sha256(blob).hexdigest(),
+                    "size": len(blob)}
+        from ..utils.atomicfile import atomic_write_json
+        atomic_write_json(
+            os.path.join(self.directory, self._MANIFEST.format(gen)),
+            manifest)
+        for old in self.generations()[:-self.keep]:
+            self._remove(old)
+        REGISTRY.inc("summary_generations_written_total")
+        return gen
+
+    def _remove(self, gen: int) -> None:
+        for fmt in (self._BLOB, self._MANIFEST):
+            try:
+                os.remove(os.path.join(self.directory, fmt.format(gen)))
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- load
+    def _verify_generation(self, gen: int) -> Tuple[Optional[bytes],
+                                                    Optional[dict], str]:
+        """(blob bytes, manifest, "") on success; (None, maybe-manifest,
+        reason) on failure. Never unpickles an unverified blob."""
+        from ..utils.atomicfile import read_json
+        try:
+            manifest = read_json(
+                os.path.join(self.directory, self._MANIFEST.format(gen)))
+        except (OSError, ValueError) as e:
+            return None, None, f"manifest unreadable: {e}"
+        try:
+            with open(os.path.join(self.directory,
+                                   self._BLOB.format(gen)), "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            return None, manifest, f"blob unreadable: {e}"
+        if len(blob) != int(manifest.get("size", -1)):
+            return None, manifest, (
+                f"blob size {len(blob)} != manifest {manifest.get('size')}")
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != manifest.get("sha256"):
+            return None, manifest, "sha256 mismatch"
+        return blob, manifest, ""
+
+    def load_generation(self, gen: int) -> Tuple[dict, int]:
+        """Load + verify ONE generation; raises on any integrity failure."""
+        blob, manifest, reason = self._verify_generation(gen)
+        if blob is None:
+            REGISTRY.inc("summary_manifest_verify_failures_total")
+            raise SummaryIntegrityError(
+                f"generation {gen} in {self.directory}: {reason}")
+        return pickle.loads(blob), int(manifest["seq"])
+
+    def load_latest(self) -> Tuple[dict, int, int]:
+        """The recovery ladder: newest verified generation wins. Returns
+        ``(summary, seq, depth)`` — depth 0 is the newest generation,
+        each corrupt rung adds 1 (and a correspondingly longer tail
+        replay for the caller). Raises :class:`SummaryIntegrityError`
+        when no rung verifies."""
+        gens = self.generations()
+        reasons = []
+        for depth, gen in enumerate(reversed(gens)):
+            blob, manifest, reason = self._verify_generation(gen)
+            if blob is None:
+                REGISTRY.inc("summary_manifest_verify_failures_total")
+                reasons.append(f"gen {gen}: {reason}")
+                continue
+            REGISTRY.set_gauge("recovery_ladder_depth", float(depth))
+            if depth:
+                from ..utils import flight_recorder
+                flight_recorder.note("recovery_ladder_fallback",
+                                     depth=depth, generation=gen)
+            return pickle.loads(blob), int(manifest["seq"]), depth
+        raise SummaryIntegrityError(
+            f"no verifiable summary generation in {self.directory} "
+            f"({len(gens)} tried): {'; '.join(reasons) or 'empty store'}")
+
+    def verify_all(self) -> List[dict]:
+        """Scrubber hook: verify every generation without loading any.
+        Returns one problem dict per failing rung (empty = clean)."""
+        problems = []
+        for gen in self.generations():
+            blob, _manifest, reason = self._verify_generation(gen)
+            if blob is None:
+                problems.append({"generation": gen, "reason": reason,
+                                 "path": os.path.join(
+                                     self.directory,
+                                     self._BLOB.format(gen))})
+        return problems
 
 
 @dataclasses.dataclass
@@ -62,10 +218,14 @@ class SummaryManager:
 
     def __init__(self, container,
                  config: Optional[SummaryConfig] = None,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 generation_store: Optional[SummaryGenerationStore] = None):
         self.container = container
         self.config = config or SummaryConfig()
         self.clock = clock or time.monotonic
+        #: optional recovery-ladder sink: every uploaded summary is also
+        #: persisted as a hashed generation (ISSUE 10)
+        self.generation_store = generation_store
         self.last_ack_seq = container.base_seq
         self.last_ack_time = self.clock()
         self._in_flight = False
@@ -184,6 +344,9 @@ class SummaryManager:
             REGISTRY.inc("summary_uploads")
             REGISTRY.observe("summary_upload_ms",
                              (time.perf_counter() - t0) * 1000)
+            if self.generation_store is not None:
+                # recovery-ladder rung: same summary, hashed manifest
+                self.generation_store.save(summary, seq)
             sp.annotate(handle=handle)
             # crash here = summary uploaded but the SUMMARIZE proposal
             # never sequenced: the upload is an orphan blob, no ack ever

@@ -72,6 +72,14 @@ class DeliSequencer:
         # service wall clock for message timestamps (reference: Deli stamps
         # ISequencedDocumentMessage.timestamp); injectable for determinism
         self.clock = clock if clock is not None else time.time
+        # writer epoch this sequencer's output is stamped under (ISSUE
+        # 10): set by the owning engine/service on takeover
+        # (acquire_write_authority / recover) and carried here so the
+        # durable-append layer can fence a deposed sequencer's stream.
+        # Deliberately NOT part of checkpoint(): the fence word's source
+        # of truth is the log's persisted fence file, never a checkpoint
+        # that may itself be stale.
+        self.epoch = 0
 
     def _doc(self, doc_id: str) -> _DocState:
         if doc_id not in self._docs:

@@ -22,6 +22,10 @@ from typing import Any, Callable, List, Optional
 
 from ..core.protocol import MessageType, SequencedDocumentMessage
 from ..native.build import ensure_built
+from ..utils.telemetry import REGISTRY
+from .oplog import (
+    FencedWriterError, OplogCorruptionError, _FencedWriter, chain_step,
+)
 
 _lib = None
 
@@ -283,9 +287,21 @@ def decode_message(data: bytes,
 class NativePartitionedLog:
     """Durable PartitionedLog on the C++ segment files: same API surface
     (append/read/size/subscribe), crash-safe — reopen the same directory
-    and every record before a torn tail is back."""
+    and every record before a torn tail is back.
 
-    def __init__(self, directory: str, n_partitions: int = 8):
+    Integrity plane (ISSUE 10): appended payloads are wrapped as
+    ``b"H" + <4-byte LE chain word> + <tagged record>`` where
+    ``chain_i = crc32(tagged_record_i, chain_{i-1})`` (seed 0) — the same
+    hash chain as ``oplog.PartitionedLog``'s spill, layered on top of the
+    C side's per-frame CRC (which catches a flipped bit in one frame but
+    not a spliced/reordered/regrown stream). The chain is verified on
+    open; pre-chain records (bare tags) pass through unverified and carry
+    the chain value forward. The log also carries the persisted epoch
+    fence word (``fence.json``) with the same ``open_for_append`` /
+    ``bump_fence`` contract as the Python log."""
+
+    def __init__(self, directory: str, n_partitions: int = 8,
+                 verify: bool = True):
         lib = _load()
         if lib is None:
             raise RuntimeError("native oplog library unavailable")
@@ -302,14 +318,133 @@ class NativePartitionedLog:
         # per-partition locks, as in oplog.PartitionedLog: the C side's
         # fseek/fwrite pairs and the shared FILE* cursor are not
         # thread-safe — an unlocked concurrent append would tear frames,
-        # which the CRC scan then silently truncates on reopen
+        # which the CRC scan then silently truncates on reopen. The
+        # explicit cursor contract: under the partition lock, the next
+        # append's offset is exactly the record count (`len(_chains[p])`,
+        # kept in lockstep with the C side and asserted on every append),
+        # so the chain verifier can never race the FILE* cursor.
         import threading
         self._plocks = [threading.RLock() for _ in range(n_partitions)]
+        self._chains: List[List[int]] = [
+            self._rebuild_chain(p, verify) for p in range(n_partitions)]
+        self._fence_mtime: Optional[int] = None
+        self.fence_epoch = self._load_fence()
 
-    def append(self, partition: int, record: Any) -> int:
+    # ------------------------------------------------------------ fence
+    def _fence_path(self) -> str:
+        import os
+        return os.path.join(self.directory, "fence.json")
+
+    def _load_fence(self) -> int:
+        import os
+        from ..utils.atomicfile import read_json
+        path = self._fence_path()
+        if not os.path.exists(path):
+            return 0
+        self._fence_mtime = os.stat(path).st_mtime_ns
+        return int(read_json(path).get("epoch", 0))
+
+    def _refresh_fence(self) -> None:
+        """Pick up a fence bump written by another process on the same
+        directory (one stat per fenced append — see oplog.PartitionedLog
+        for the cross-instance split-brain rationale)."""
+        import os
+        from ..utils.atomicfile import read_json
+        try:
+            m = os.stat(self._fence_path()).st_mtime_ns
+        except OSError:
+            return
+        if m != self._fence_mtime:
+            self._fence_mtime = m
+            try:
+                self.fence_epoch = max(
+                    self.fence_epoch,
+                    int(read_json(self._fence_path()).get("epoch", 0)))
+            except (OSError, ValueError):
+                pass
+
+    def fence(self, epoch: int) -> int:
+        """Raise the persisted fence word to ``epoch`` (monotone)."""
+        import os
+        from ..utils.atomicfile import atomic_write_json
+        self._refresh_fence()
+        self.fence_epoch = max(self.fence_epoch, int(epoch))
+        atomic_write_json(self._fence_path(), {"epoch": self.fence_epoch})
+        self._fence_mtime = os.stat(self._fence_path()).st_mtime_ns
+        return self.fence_epoch
+
+    def bump_fence(self) -> int:
+        """Takeover edge: advance the fence; stale writers get
+        :class:`FencedWriterError` on their next append."""
+        return self.fence(self.fence_epoch + 1)
+
+    def open_for_append(self, epoch: int) -> _FencedWriter:
+        """Return a fenced append handle bound to ``epoch``."""
+        self._refresh_fence()
+        if epoch < self.fence_epoch:
+            REGISTRY.inc("fenced_appends_rejected_total")
+            raise FencedWriterError(
+                f"{self.directory}: epoch {epoch} is behind fence "
+                f"{self.fence_epoch}", epoch=epoch, fence=self.fence_epoch)
+        return _FencedWriter(self, epoch)
+
+    # ------------------------------------------------------------ chain
+    def _rebuild_chain(self, partition: int, verify: bool) -> List[int]:
+        """Walk the partition's surviving records (the C side already
+        truncated any torn tail on open) and rebuild — and optionally
+        verify — the hash chain from the raw frame payloads."""
+        chains: List[int] = []
+        chain = 0
+        for off in range(self.size(partition)):
+            raw = self._raw(partition, off)
+            if raw[:1] == b"H":
+                stored = int.from_bytes(raw[1:5], "little")
+                if verify and stored != chain_step(raw[5:], chain):
+                    REGISTRY.inc("oplog_chain_verify_failures_total")
+                    raise OplogCorruptionError(
+                        f"chain break mid-file in {self.directory} "
+                        f"p{partition} record {off}: stored "
+                        f"{stored:#010x} != expected chain — not a crash "
+                        f"torn-tail", path=self.directory, index=off,
+                        reason="chain mismatch")
+                chain = stored
+            # pre-chain record: carry the chain value forward, unverified
+            chains.append(chain)
+        return chains
+
+    def chain_head(self, partition: int) -> int:
+        """Current chain word of the partition (0 when empty)."""
+        with self._plocks[partition]:
+            ch = self._chains[partition]
+            return ch[-1] if ch else 0
+
+    def chain_at(self, partition: int, offset: int) -> Optional[int]:
+        """Chain word after the first ``offset`` records (``offset=0`` →
+        the seed 0); ``None`` when the partition is shorter than
+        ``offset`` (truncation!)."""
+        with self._plocks[partition]:
+            ch = self._chains[partition]
+            if offset == 0:
+                return 0
+            if offset > len(ch):
+                return None
+            return ch[offset - 1]
+
+    def append(self, partition: int, record: Any,
+               epoch: Optional[int] = None) -> int:
         # tags: b"N" = message with the current header (has timestamp),
         # b"M" = pre-timestamp header (old logs, read-only), b"C" =
-        # columnar batch, b"J" = plain JSON control record
+        # columnar batch, b"J" = plain JSON control record; the stored
+        # payload wraps the tagged record in the b"H" chain frame
+        if epoch is not None:
+            if epoch >= self.fence_epoch:
+                self._refresh_fence()  # persisted word may be ahead
+            if epoch < self.fence_epoch:
+                REGISTRY.inc("fenced_appends_rejected_total")
+                raise FencedWriterError(
+                    f"{self.directory}/p{partition}: append from stale "
+                    f"epoch {epoch} (fence {self.fence_epoch})",
+                    epoch=epoch, fence=self.fence_epoch)
         if isinstance(record, SequencedDocumentMessage):
             tag, data = b"N", encode_message(record)
         elif _is_columnar(record):
@@ -329,10 +464,21 @@ class NativePartitionedLog:
                     f"or JSON-safe data): {e}") from None
             tag = b"J"
         with self._plocks[partition]:
-            offset = self._lib.oplog_append(self._h, partition, tag + data,
-                                            len(data) + 1)
+            chains = self._chains[partition]
+            expected_off = len(chains)
+            inner = tag + data
+            chain = chain_step(inner, chains[-1] if chains else 0)
+            payload = b"H" + chain.to_bytes(4, "little") + inner
+            offset = self._lib.oplog_append(self._h, partition, payload,
+                                            len(payload))
             if offset < 0:
                 raise IOError(f"append to partition {partition} failed")
+            # the explicit FILE*-cursor invariant: the C append cursor and
+            # our chain list advance in lockstep under the partition lock
+            assert offset == expected_off, (
+                f"oplog cursor desync on p{partition}: C side returned "
+                f"offset {offset}, chain tracks {expected_off}")
+            chains.append(chain)
             for fn in list(self._subs[partition]):
                 fn(partition, offset, record)
         return offset
@@ -348,7 +494,8 @@ class NativePartitionedLog:
     def size(self, partition: int) -> int:
         return int(self._lib.oplog_size(self._h, partition))
 
-    def _record(self, partition: int, offset: int) -> Any:
+    def _raw(self, partition: int, offset: int) -> bytes:
+        """Read one record's raw frame payload (chain wrapper intact)."""
         with self._plocks[partition]:
             n = self._lib.oplog_record_len(self._h, partition, offset)
             if n < 0:
@@ -357,7 +504,12 @@ class NativePartitionedLog:
             got = self._lib.oplog_read(self._h, partition, offset, buf, n)
             if got != n:
                 raise IOError(f"read p{partition}@{offset} failed (CRC?)")
-        raw = bytes(buf)
+        return bytes(buf)
+
+    def _record(self, partition: int, offset: int) -> Any:
+        raw = self._raw(partition, offset)
+        if raw[:1] == b"H":  # chain frame: 4-byte LE word, then the record
+            raw = raw[5:]
         if raw[:1] == b"N":
             return decode_message(raw[1:])
         if raw[:1] == b"M":  # pre-timestamp record from an older log

@@ -15,6 +15,27 @@ semantics as the native log's CRC-checked tail truncation
 (``native_oplog``). An op lost to a torn tail was by construction never
 acked (``append`` returns — and the caller acks — only after the line is
 fully written and flushed).
+
+Durability integrity plane (ISSUE 10):
+
+**Checksum chain.** Every spilled line is prefixed with an 8-hex-digit
+chain word: ``chain_i = crc32(payload_i, chain_{i-1})`` (zlib CRC-32,
+seeded with the previous record's chain word, ``chain_{-1} = 0``). The
+word covers the exact payload bytes on disk — never a re-serialization —
+so a flipped bit, a mid-file truncation that regrows, or a spliced /
+reordered record all break the chain at a detectable offset. Verification
+runs on ``recover()`` and whenever a reader anchors a tail replay against
+a summary's recorded chain head (``chain_at``). Legacy lines (bare JSON,
+no prefix) are accepted unverified so pre-chain spills still replay. The
+chain protects bytes on disk: a memory-only log (no spill) has no chain
+and ``chain_head``/``chain_at`` return ``None``.
+
+**Epoch fence.** The log carries a monotonic fence word (persisted next
+to the spill as ``{name}-fence.json``). ``open_for_append(epoch)`` hands
+out a fenced writer; an append stamped with an epoch below the fence
+raises :class:`FencedWriterError` instead of interleaving seqs — the
+Kafka zombie-producer fence. ``bump_fence()`` is the takeover edge, used
+by ``LocalService.recover()`` and ``OplogFollower.promote()``.
 """
 
 from __future__ import annotations
@@ -23,14 +44,46 @@ import dataclasses
 import json
 import os
 import threading
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.atomicfile import atomic_write_json, read_json
 from ..utils.faultpoints import (
     SITE_OPLOG_MID_APPEND, SITE_OPLOG_MID_SPILL, fault_point,
 )
 from ..utils.telemetry import REGISTRY
+
+
+class OplogCorruptionError(ValueError):
+    """A durable record failed its checksum chain (or is unparseable in a
+    position a crash cannot produce). Carries the evidence a scrubber or
+    an operator needs: file, record index, byte offset, reason."""
+
+    def __init__(self, message: str, *, path: str = "",
+                 index: int = -1, offset: int = -1, reason: str = ""):
+        super().__init__(message)
+        self.path = path
+        self.index = index
+        self.offset = offset
+        self.reason = reason
+
+
+class FencedWriterError(RuntimeError):
+    """An append carried an epoch below the log's fence word — the caller
+    is a deposed writer (split-brain) and must not extend the stream."""
+
+    def __init__(self, message: str, *, epoch: int = -1, fence: int = -1):
+        super().__init__(message)
+        self.epoch = epoch
+        self.fence = fence
+
+
+def chain_step(payload: bytes, prev: int) -> int:
+    """One link of the checksum chain: CRC-32 of the record's exact
+    on-disk payload bytes, seeded with the previous record's chain word."""
+    return zlib.crc32(payload, prev & 0xFFFFFFFF) & 0xFFFFFFFF
 
 
 def _spill_json(o):
@@ -85,35 +138,119 @@ def partition_of(doc_id: str, n_partitions: int) -> int:
     return h % n_partitions
 
 
-def _read_spill_tolerant(path: str) -> Tuple[List[Any], int, bool]:
-    """Parse one partition's JSONL spill. Returns (records, byte offset
-    of the end of the last COMPLETE record, whether a torn tail was
-    dropped). A decode failure on any line but the last is real
-    corruption (not a crash artifact) and raises."""
+def scan_chained_spill(path: str, decode: bool = False) -> Dict[str, Any]:
+    """Scan one partition's JSONL spill, verifying the checksum chain.
+
+    Never raises on corrupt content — callers decide policy. Returns::
+
+        {"records": [...],     # parsed (decode=True revives dataclasses)
+         "chains":  [...],     # cumulative chain word after each record
+         "offsets": [...],     # byte offset each record starts at
+         "good_end": int,      # byte end of the verified prefix
+         "torn": bool,         # unterminated junk tail dropped (crash)
+         "problems": [...]}    # [{"index","offset","reason"}] — scan
+                               # stops at the first problem (the chain is
+                               # meaningless past a break)
+
+    Line grammar: ``<8 hex chain word><space><json payload>\\n``. Lines
+    starting with ``{`` are legacy (pre-chain) records: parsed, chain
+    carried through unchanged, never verified. A parse/verify failure on
+    the LAST, unterminated line is a torn tail (crash artifact); the same
+    failure anywhere else — or on a newline-terminated last line — is a
+    problem (real corruption)."""
     records: List[Any] = []
+    chains: List[int] = []
+    offsets: List[int] = []
+    problems: List[Dict[str, Any]] = []
     good_end = 0
     torn = False
+    chain = 0
     with open(path, "rb") as f:
         data = f.read()
+    if not data:
+        # an empty spill is clean (a partition that never wrote), not a
+        # torn tail — split() would otherwise yield one unterminated
+        # empty "line" here
+        return {"records": records, "chains": chains, "offsets": offsets,
+                "good_end": 0, "torn": False, "problems": problems}
     lines = data.split(b"\n")
-    # data ending in "\n" yields a trailing b"" — complete final record;
-    # anything else in the last slot is a torn tail candidate
-    for i, line in enumerate(lines):
-        last = i == len(lines) - 1
-        if last and line == b"":
-            break
-        try:
-            records.append(
-                _spill_decode(json.loads(line.decode("utf-8"))))
-            good_end += len(line) + 1
-        except (ValueError, UnicodeDecodeError):
-            if not last:
-                raise ValueError(
-                    f"corrupt spill record mid-file in {path} "
-                    f"(line {i + 1}): not a crash torn-tail")
+    terminated = data.endswith(b"\n")
+    n_lines = len(lines) - (1 if terminated else 0)
+    for i in range(n_lines):
+        line = lines[i]
+        if i == n_lines - 1 and not terminated:
+            # an unterminated final line is a torn tail even when it
+            # parses: its flush never completed (so it was never acked),
+            # and keeping it would fuse the next append onto the same
+            # physical line
             torn = True
             break
-    return records, good_end, torn
+        reason = None
+        payload = line
+        stored = None
+        if line[:1] != b"{":
+            # chained line: 8-hex chain word, space, payload
+            if len(line) >= 10 and line[8:9] == b" ":
+                try:
+                    stored = int(line[:8], 16)
+                except ValueError:
+                    reason = "bad chain word"
+                payload = line[9:]
+            else:
+                reason = "unparseable line"
+        if reason is None:
+            try:
+                obj = json.loads(payload.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                reason = "unparseable record"
+            else:
+                if stored is not None:
+                    expect = chain_step(payload, chain)
+                    if stored != expect:
+                        reason = "chain mismatch"
+        if reason is not None:
+            problems.append(
+                {"index": i, "offset": good_end, "reason": reason})
+            break
+        offsets.append(good_end)
+        chain = chain if stored is None else stored
+        chains.append(chain)
+        records.append(_spill_decode(obj) if decode else obj)
+        good_end += len(line) + 1
+    return {"records": records, "chains": chains, "offsets": offsets,
+            "good_end": good_end, "torn": torn, "problems": problems}
+
+
+def _read_spill_tolerant(path: str) -> Tuple[List[Any], int, bool, List[int]]:
+    """Parse one partition's JSONL spill, verifying the checksum chain.
+    Returns (records, byte offset of the end of the last verified record,
+    whether a torn tail was dropped, per-record chain words). A decode or
+    chain failure on any line but an unterminated last one is real
+    corruption (not a crash artifact) and raises
+    :class:`OplogCorruptionError`."""
+    scan = scan_chained_spill(path, decode=True)
+    if scan["problems"]:
+        p = scan["problems"][0]
+        REGISTRY.inc("oplog_chain_verify_failures_total")
+        raise OplogCorruptionError(
+            f"corrupt spill record mid-file in {path} "
+            f"(record {p['index'] + 1}, byte {p['offset']}): "
+            f"{p['reason']} — not a crash torn-tail",
+            path=path, index=p["index"], offset=p["offset"],
+            reason=p["reason"])
+    return scan["records"], scan["good_end"], scan["torn"], scan["chains"]
+
+
+class _FencedWriter:
+    """Append handle bound to one epoch — every append it forwards is
+    fence-checked against the log's current fence word."""
+
+    def __init__(self, log: "PartitionedLog", epoch: int):
+        self.log = log
+        self.epoch = epoch
+
+    def append(self, partition: int, record: Any) -> int:
+        return self.log.append(partition, record, epoch=self.epoch)
 
 
 class PartitionedLog:
@@ -132,13 +269,108 @@ class PartitionedLog:
         # observe offsets in order.
         self._plocks = [threading.RLock() for _ in range(n_partitions)]
         self._spill = None
+        # cumulative chain word per appended record, per partition; only
+        # maintained when a spill exists (the chain covers disk bytes)
+        self._chains: Optional[List[List[int]]] = None
+        self._fence_mtime: Optional[int] = None
+        self.fence_epoch = 0
         if spill_dir is not None:
             os.makedirs(spill_dir, exist_ok=True)
             self._spill = [
                 open(os.path.join(spill_dir, f"{name}-p{i}.jsonl"), "a")
                 for i in range(n_partitions)
             ]
+            self._chains = [[] for _ in range(n_partitions)]
+            self.fence_epoch = self._load_fence()
 
+    # ------------------------------------------------------------------
+    # epoch fence
+    def _fence_path(self) -> Optional[str]:
+        if self.spill_dir is None:
+            return None
+        return os.path.join(self.spill_dir, f"{self.name}-fence.json")
+
+    def _load_fence(self) -> int:
+        path = self._fence_path()
+        if path is None or not os.path.exists(path):
+            return 0
+        self._fence_mtime = os.stat(path).st_mtime_ns
+        return int(read_json(path).get("epoch", 0))
+
+    def _refresh_fence(self) -> None:
+        """Pick up a fence bump written by ANOTHER process/instance on
+        the same spill dir (one stat per fenced append — the split-brain
+        case is a separate recovered service, not just a shared log
+        object). Monotone: the file can only raise the in-memory word."""
+        path = self._fence_path()
+        if path is None:
+            return
+        try:
+            m = os.stat(path).st_mtime_ns
+        except OSError:
+            return
+        if m != self._fence_mtime:
+            self._fence_mtime = m
+            try:
+                self.fence_epoch = max(
+                    self.fence_epoch, int(read_json(path).get("epoch", 0)))
+            except (OSError, ValueError):
+                pass
+
+    def fence(self, epoch: int) -> int:
+        """Raise the fence word to ``epoch`` (monotone; persisted when a
+        spill exists). Appends stamped below the fence are rejected."""
+        self._refresh_fence()
+        self.fence_epoch = max(self.fence_epoch, int(epoch))
+        path = self._fence_path()
+        if path is not None:
+            atomic_write_json(path, {"epoch": self.fence_epoch})
+            self._fence_mtime = os.stat(path).st_mtime_ns
+        return self.fence_epoch
+
+    def bump_fence(self) -> int:
+        """The takeover edge: advance the fence by one and return the new
+        epoch — the caller is now the sole legitimate writer; any handle
+        still stamping the old epoch gets :class:`FencedWriterError`."""
+        return self.fence(self.fence_epoch + 1)
+
+    def open_for_append(self, epoch: int) -> _FencedWriter:
+        """Return a fenced append handle bound to ``epoch``. The epoch
+        must be current (>= the fence word) at open time."""
+        self._refresh_fence()
+        if epoch < self.fence_epoch:
+            REGISTRY.inc("fenced_appends_rejected_total")
+            raise FencedWriterError(
+                f"{self.name}: epoch {epoch} is behind fence "
+                f"{self.fence_epoch}", epoch=epoch, fence=self.fence_epoch)
+        return _FencedWriter(self, epoch)
+
+    # ------------------------------------------------------------------
+    # checksum chain
+    def chain_head(self, partition: int) -> Optional[int]:
+        """Current chain word of the partition (0 when empty); ``None``
+        for a memory-only log (no durable bytes → no chain)."""
+        if self._chains is None:
+            return None
+        with self._plocks[partition]:
+            ch = self._chains[partition]
+            return ch[-1] if ch else 0
+
+    def chain_at(self, partition: int, offset: int) -> Optional[int]:
+        """Chain word after the first ``offset`` records (``offset=0`` →
+        the seed 0); ``None`` when unavailable (memory-only log, or the
+        partition is shorter than ``offset`` — truncation!)."""
+        if self._chains is None:
+            return None
+        with self._plocks[partition]:
+            ch = self._chains[partition]
+            if offset == 0:
+                return 0
+            if offset > len(ch):
+                return None
+            return ch[offset - 1]
+
+    # ------------------------------------------------------------------
     @classmethod
     def recover(cls, n_partitions: int, spill_dir: str,
                 name: str = "log") -> "PartitionedLog":
@@ -146,27 +378,49 @@ class PartitionedLog:
         (partial last line from a mid-write kill) are dropped and the
         file truncated back to the last complete record, so subsequent
         appends continue a clean stream — matching ``native_oplog``'s
-        CRC tail truncation. Returns a log with spill re-attached."""
+        CRC tail truncation. Every surviving record's checksum chain is
+        verified; a mid-file break raises :class:`OplogCorruptionError`
+        (run ``tools/log_scrub.py --repair`` to truncate to the verified
+        prefix). Returns a log with spill re-attached."""
         records: List[List[Any]] = []
+        chains: List[List[int]] = []
         for i in range(n_partitions):
             path = os.path.join(spill_dir, f"{name}-p{i}.jsonl")
             if not os.path.exists(path):
                 records.append([])
+                chains.append([])
                 continue
-            recs, good_end, torn = _read_spill_tolerant(path)
+            recs, good_end, torn, ch = _read_spill_tolerant(path)
             if torn:
                 REGISTRY.inc("oplog_torn_tails_recovered")
                 with open(path, "r+b") as f:
                     f.truncate(good_end)
             records.append(recs)
+            chains.append(ch)
         log = cls(n_partitions, spill_dir, name)
         for i, recs in enumerate(records):
             log._parts[i] = recs
+            log._chains[i] = chains[i]
         return log
 
-    def append(self, partition: int, record: Any) -> int:
+    def append(self, partition: int, record: Any,
+               epoch: Optional[int] = None) -> int:
         """Append; returns the record's offset. Notifies subscribers inline,
-        in offset order (in-process stand-in for the consumer poll loop)."""
+        in offset order (in-process stand-in for the consumer poll loop).
+        ``epoch`` (from a fenced writer) is checked against the fence word
+        BEFORE any mutation — a deposed writer changes nothing."""
+        if epoch is not None:
+            if epoch >= self.fence_epoch and self._spill is not None:
+                # would pass on the in-memory word: check the persisted
+                # one too (a recovered instance in another process bumps
+                # the file, not this object)
+                self._refresh_fence()
+            if epoch < self.fence_epoch:
+                REGISTRY.inc("fenced_appends_rejected_total")
+                raise FencedWriterError(
+                    f"{self.name}/p{partition}: append from stale epoch "
+                    f"{epoch} (fence {self.fence_epoch})",
+                    epoch=epoch, fence=self.fence_epoch)
         with self._plocks[partition]:
             part = self._parts[partition]
             offset = len(part)
@@ -176,7 +430,11 @@ class PartitionedLog:
             fault_point(SITE_OPLOG_MID_APPEND, partition=partition,
                         offset=offset)
             if self._spill is not None:
-                line = json.dumps(record, default=_spill_json) + "\n"
+                payload = json.dumps(record, default=_spill_json)
+                prev = self._chains[partition]
+                chain = chain_step(
+                    payload.encode("utf-8"), prev[-1] if prev else 0)
+                line = f"{chain:08x} {payload}\n"
                 # crash mid-line = the torn tail recovery must tolerate;
                 # an armed plan may ask for a partial write (realistic
                 # kill between write syscalls)
@@ -185,6 +443,7 @@ class PartitionedLog:
                             fh=self._spill[partition])
                 self._spill[partition].write(line)
                 self._spill[partition].flush()
+                prev.append(chain)
                 REGISTRY.inc("oplog_spill_lines")
                 REGISTRY.inc("oplog_spill_bytes", len(line))
             for fn in list(self._subs[partition]):

@@ -44,7 +44,7 @@ from ..ops.schema import OpKind
 from ..ops.string_store import TensorStringStore
 from ..ops.tree_kernel import TreeOpKind
 from .deli import DeliSequencer, Nack, NackReason
-from .oplog import PartitionedLog, partition_of
+from .oplog import OplogCorruptionError, PartitionedLog, partition_of
 
 
 class DedupLedger:
@@ -318,6 +318,14 @@ class ServingEngineBase:
                  sequencer: str = "python"):
         self.deli = make_sequencer(sequencer)
         self.log = log if log is not None else PartitionedLog(n_partitions)
+        # epoch this engine stamps on durable appends (ISSUE 10): reads
+        # the log's CURRENT fence word — constructing/loading an engine
+        # never bumps the fence (a read-only follower must not depose the
+        # leader); takeover goes through acquire_write_authority().
+        self.writer_epoch: Optional[int] = getattr(
+            self.log, "fence_epoch", None)
+        # the sequencer carries the epoch its stream is stamped under
+        setattr(self.deli, "epoch", self.writer_epoch or 0)
         self.batch_window = batch_window
         self.compact_every = compact_every
         self._doc_rows: Dict[str, int] = {}
@@ -582,12 +590,34 @@ class ServingEngineBase:
         return np.minimum(ref_flat.astype(np.int64),
                           np.maximum(out_seq - 1, 0))
 
+    def _fenced_append(self, partition: int, record: Any) -> int:
+        """Durable append stamped with this engine's writer epoch — a
+        deposed engine (fence bumped by a promoted follower or a
+        recovered service) gets :class:`FencedWriterError` here instead
+        of interleaving seqs into the stream it no longer owns."""
+        if self.writer_epoch is None:  # log without a fence word
+            return self.log.append(partition, record)
+        return self.log.append(partition, record,
+                               epoch=self.writer_epoch)
+
+    def acquire_write_authority(self) -> Optional[int]:
+        """Takeover edge: bump the log's fence and adopt the new epoch —
+        every other live engine on this log becomes a fenced zombie.
+        Called by ``OplogFollower.promote()``; ``LocalService.recover()``
+        does the equivalent on its service-level logs."""
+        bump = getattr(self.log, "bump_fence", None)
+        if bump is None:
+            return None
+        self.writer_epoch = bump()
+        setattr(self.deli, "epoch", self.writer_epoch)
+        return self.writer_epoch
+
     def _append_columnar(self, record: "ColumnarOps") -> None:
         """Whole-batch durable append (round-robin partition for balance)
         + poison clear: sequence → merge → log completed."""
         p = self._col_part
         self._col_part = (p + 1) % self.log.n_partitions
-        self.log.append(int(p), record)
+        self._fenced_append(int(p), record)
         self.partition_metrics[p].inc("appends")
         self._ingest_mark_logged()
 
@@ -735,7 +765,7 @@ class ServingEngineBase:
 
     def _log_append(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
         p = partition_of(doc_id, self.log.n_partitions)
-        self.log.append(p, msg)
+        self._fenced_append(p, msg)
         self.partition_metrics[p].inc("appends")
 
     def _enqueue(self, doc_id: str, msg: SequencedDocumentMessage) -> None:
@@ -862,10 +892,19 @@ class ServingEngineBase:
 
     def _base_summary(self) -> dict:
         self._check_poisoned()
+        sizes = [self.log.size(p) for p in range(self.log.n_partitions)]
+        chain_at = getattr(self.log, "chain_at", None)
         out = {
             "deli": self.deli.checkpoint(),
-            "log_offsets": [self.log.size(p)
-                            for p in range(self.log.n_partitions)],
+            "log_offsets": sizes,
+            # checksum-chain anchor (ISSUE 10): the chain word at each
+            # partition's summary offset; load() verifies the live log
+            # still carries these exact bytes before tail replay — a
+            # truncated-then-regrown or spliced log fails loudly instead
+            # of silently replaying a different history. None per
+            # partition when the log has no durable chain (memory-only).
+            "chain_heads": [chain_at(p, s) if chain_at is not None
+                            else None for p, s in enumerate(sizes)],
             "doc_rows": dict(self._doc_rows),
             "min_seq": dict(self._min_seq),
             "dedup": self._dedup.snapshot(),
@@ -880,6 +919,7 @@ class ServingEngineBase:
         # keep the engine's (possibly injected deterministic) clock
         self.deli = restore_sequencer(summary["deli"],
                                       clock=self.deli.clock)
+        setattr(self.deli, "epoch", self.writer_epoch or 0)
         self._doc_rows = dict(summary["doc_rows"])
         used = set(self._doc_rows.values())
         self._next_row = max(used) + 1 if used else 0
@@ -903,6 +943,39 @@ class ServingEngineBase:
             self._attributors = {d: Attributor.load(a)
                                  for d, a in summary["attribution"].items()}
 
+    def _verify_tail_anchor(self, summary: dict) -> None:
+        """Anchor the tail replay against the summary's recorded chain
+        heads: the live log must (a) still reach every partition's
+        summary offset — a shorter log means the durable stream was
+        truncated at a record boundary, which no local scan can see —
+        and (b) carry the exact chain word the summary saw there, so a
+        spliced/regrown prefix fails before a single byte is replayed."""
+        offsets = summary.get("log_offsets")
+        if offsets is None:
+            return
+        heads = summary.get("chain_heads")
+        chain_at = getattr(self.log, "chain_at", None)
+        for p in range(self.log.n_partitions):
+            off = int(offsets[p])
+            if self.log.size(p) < off:
+                REGISTRY.inc("oplog_chain_verify_failures_total")
+                raise OplogCorruptionError(
+                    f"log p{p} holds {self.log.size(p)} records but the "
+                    f"summary was cut at offset {off}: durable stream "
+                    f"truncated behind the summary", index=off,
+                    reason="log shorter than summary anchor")
+            if heads is None or chain_at is None or heads[p] is None:
+                continue
+            have = chain_at(p, off)
+            if have != int(heads[p]):
+                REGISTRY.inc("oplog_chain_verify_failures_total")
+                raise OplogCorruptionError(
+                    f"log p{p} chain word at offset {off} is "
+                    f"{'absent' if have is None else hex(have)}, summary "
+                    f"anchored {int(heads[p]):#010x}: log bytes diverged "
+                    f"from the summarized history", index=off,
+                    reason="chain anchor mismatch")
+
     def _replay_tail(self, summary: dict, control_hook=None) -> None:
         """Replay EVERY tail message through the sequencer state (so
         resumed sequencing continues past the tail, not from the stale
@@ -910,6 +983,7 @@ class ServingEngineBase:
         survive recovery); OPs queue for the device merge. A
         ``control_hook(msg) -> True`` consumes engine-specific control
         records before they reach the stores."""
+        self._verify_tail_anchor(summary)
         tail: List[SequencedDocumentMessage] = []
         for p in range(self.log.n_partitions):
             for rec in self.log.read(p,
@@ -1452,7 +1526,7 @@ class StringServingEngine(ServingEngineBase):
                 if lo == hi:
                     continue
                 sl = slice(lo, hi)
-                self.log.append(int(p), ColumnarOps(
+                self._fenced_append(int(p), ColumnarOps(
                     ids, row_sorted[sl], *(g[sl] for g in gathered),
                     text=text, timestamp=ts, texts=texts, props=props,
                     tidx=None if tidx_flat is None else tidx_flat[sl]))
@@ -3570,6 +3644,7 @@ class TreeServingEngine(ServingEngineBase):
         messages re-encode through the emitter; everything merges per doc
         in seq order — the sequencer replays every message in the same
         order (the r4 partition-scan-order fix)."""
+        self._verify_tail_anchor(summary)
         items: List[tuple] = []   # (doc_id, seq, msg, raw recs or None)
         for p in range(self.log.n_partitions):
             for rec in self.log.read(

@@ -120,6 +120,14 @@ class LocalService:
         #: clients at connect/resync so they can tell a reconnect to the
         #: same instance from a reconnect across a restart
         self.epoch = 0
+        #: writer epoch stamped on every durable append (ISSUE 10): the
+        #: logs' persisted fence word at open. ``recover()`` bumps the
+        #: fence, so an instance deposed by a recovery gets
+        #: ``FencedWriterError`` on its next append instead of
+        #: interleaving seqs into a stream it no longer owns.
+        self.writer_epoch = max(self.raw_log.fence_epoch,
+                                self.deltas_log.fence_epoch)
+        self.deli.epoch = self.writer_epoch
         # wire the pipeline: raw -> deli -> deltas -> fan-out lambdas
         for p in range(n_partitions):
             self.raw_log.subscribe(p, self._deli_consume)
@@ -208,7 +216,8 @@ class LocalService:
         self.raw_log.append(p, dict(
             doc_id=doc_id, client_id=client_id, client_seq=client_seq,
             ref_seq=ref_seq, type=int(type), contents=contents,
-            address=address, trace=tracing.current_wire()))
+            address=address, trace=tracing.current_wire()),
+            epoch=self.writer_epoch)
 
     def _deli_consume(self, partition: int, offset: int, raw: dict) -> None:
         with self._lock:
@@ -251,7 +260,7 @@ class LocalService:
 
     def _publish(self, msg: SequencedDocumentMessage) -> None:
         p = partition_of(msg.doc_id, self.deltas_log.n_partitions)
-        self.deltas_log.append(p, msg)
+        self.deltas_log.append(p, msg, epoch=self.writer_epoch)
 
     def _note_acked(self, msg: SequencedDocumentMessage) -> None:
         """Record a durably-sequenced op in the dedup ledger (bounded per
@@ -348,6 +357,15 @@ class LocalService:
         self._connections = {}
         self._acked = {}
         self.epoch = self._bump_epoch(spill_dir)
+        # takeover edge: advance both logs' fence words and adopt the new
+        # epoch — if the crashed instance is somehow still live (a
+        # supervisor double-start, the split-brain drill), its next
+        # append raises FencedWriterError instead of extending the stream
+        self.writer_epoch = max(self.raw_log.bump_fence(),
+                                self.deltas_log.bump_fence())
+        self.raw_log.fence(self.writer_epoch)
+        self.deltas_log.fence(self.writer_epoch)
+        self.deli.epoch = self.writer_epoch
         # 1) the durable deltas stream IS the recovery truth: global
         # (doc, seq) order mirrors _replay_tail's convention
         msgs: List[SequencedDocumentMessage] = []

@@ -202,6 +202,82 @@ def disarm(site: str) -> None:
         plan.disarm(site)
 
 
+# ------------------------------------------------- corruption injectors
+# Seeded disk-rot simulators for the durability-integrity drills (ISSUE
+# 10): they mutate a durable file IN PLACE the way real corruption does —
+# a flipped bit, a truncation that may later regrow, a spliced-out record
+# — and return an evidence dict so the drill can assert the detection
+# layer reports the SAME location. They are deliberately plain file
+# operations (no log/format knowledge): the integrity plane must detect
+# arbitrary byte damage, not only damage shaped like its own framing.
+
+CORRUPTION_KINDS = ("bitflip", "truncate", "splice")
+
+
+def corrupt_bitflip(path: str, rng: random.Random) -> dict:
+    """Flip ONE random bit somewhere in the file."""
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if not data:
+        return {"kind": "bitflip", "path": path, "skipped": "empty file"}
+    off = rng.randrange(len(data))
+    bit = rng.randrange(8)
+    data[off] ^= 1 << bit
+    with open(path, "wb") as f:
+        f.write(data)
+    return {"kind": "bitflip", "path": path, "offset": off, "bit": bit}
+
+
+def corrupt_truncate(path: str, rng: random.Random) -> dict:
+    """Cut the file at a random interior byte (NOT a record boundary on
+    purpose — boundary truncation is the harder case the summary chain
+    anchor exists for; callers wanting it can truncate exactly)."""
+    import os
+    size = os.path.getsize(path)
+    if size < 2:
+        return {"kind": "truncate", "path": path, "skipped": "too small"}
+    cut = rng.randrange(1, size)
+    with open(path, "r+b") as f:
+        f.truncate(cut)
+    return {"kind": "truncate", "path": path, "offset": cut,
+            "dropped_bytes": size - cut}
+
+
+def corrupt_splice(path: str, rng: random.Random) -> dict:
+    """Remove one interior line (newline-framed files: a clean record
+    splice) or, for binary files with too few lines, one interior 16-byte
+    chunk — the 'a record vanished but the stream still looks healthy'
+    case only a checksum CHAIN can see."""
+    with open(path, "rb") as f:
+        data = f.read()
+    lines = data.split(b"\n")
+    # newline-framed with at least 3 complete interior candidates
+    if len(lines) >= 4 and data.endswith(b"\n"):
+        i = rng.randrange(1, len(lines) - 2)  # never the first or torn slot
+        cut = lines[:i] + lines[i + 1:]
+        with open(path, "wb") as f:
+            f.write(b"\n".join(cut))
+        return {"kind": "splice", "path": path, "line": i,
+                "dropped_bytes": len(lines[i]) + 1}
+    if len(data) < 48:
+        return {"kind": "splice", "path": path, "skipped": "too small"}
+    off = rng.randrange(16, len(data) - 32)
+    with open(path, "wb") as f:
+        f.write(data[:off] + data[off + 16:])
+    return {"kind": "splice", "path": path, "offset": off,
+            "dropped_bytes": 16}
+
+
+def corrupt_file(path: str, kind: str, rng: random.Random) -> dict:
+    """Dispatch one corruption of ``kind`` ∈ :data:`CORRUPTION_KINDS`."""
+    fn = {"bitflip": corrupt_bitflip, "truncate": corrupt_truncate,
+          "splice": corrupt_splice}.get(kind)
+    if fn is None:
+        raise ValueError(f"unknown corruption kind {kind!r} "
+                         f"(want one of {CORRUPTION_KINDS})")
+    return fn(path, rng)
+
+
 # Core sites declared centrally (hosts may declare more):
 SITE_DELI_MID_WINDOW = declare_site("deli.sequence.mid_window")
 SITE_OPLOG_MID_APPEND = declare_site("oplog.append.mid")

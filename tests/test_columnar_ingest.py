@@ -251,8 +251,12 @@ def test_columnar_spill_is_lossless(tmp_path):
     eng.log.close()
     total_ops = 0
     for f in tmp_path.iterdir():
+        if f.suffix != ".jsonl":
+            continue
         for line in f.read_text().splitlines():
-            rec = json.loads(line)
+            # chained spill grammar: `<8-hex chain word> <json>`
+            rec = json.loads(line if line.startswith("{")
+                             else line.split(" ", 1)[1])
             if isinstance(rec, dict) and rec.get("__type__") == "ColumnarOps":
                 assert "..." not in json.dumps(rec["seq"])
                 total_ops += len(rec["seq"])
@@ -464,12 +468,12 @@ class _FailingLog(PartitionedLog):
         self.fail = True
         self._appends_until_fail = appends_until_fail
 
-    def append(self, p, rec):
+    def append(self, p, rec, epoch=None):
         if self.fail:
             if self._appends_until_fail <= 0:
                 raise IOError("disk full")
             self._appends_until_fail -= 1
-        super().append(p, rec)
+        super().append(p, rec, epoch=epoch)
 
 
 def test_append_failure_poisons_engine_and_blocks_summary():

@@ -199,6 +199,21 @@ def reconnect_storm_block(rec: dict) -> str | None:
     return json.dumps(out)
 
 
+def durability_block(rec: dict) -> str | None:
+    """Durability fenced block (ISSUE 10: recovery ladder timings + the
+    scrub's chain-break count), or None on records predating the
+    phase."""
+    dur = rec.get("durability")
+    if not isinstance(dur, dict):
+        return None
+    out = {"metric": "recovery_ladder_ms", "unit": "ms"}
+    out.update({k: dur[k] for k in (
+        "recovery_ladder_ms", "ladder_depths", "ops_replayed",
+        "generations_kept", "chain_breaks", "records_scrubbed",
+        "error") if k in dur})
+    return json.dumps(out)
+
+
 _FENCE_RE = re.compile(r"```json\n.*?\n```", re.S)
 
 
@@ -236,7 +251,8 @@ def regenerate(root: Path, json_path: Path | None = None,
                            ("## Tree serving", tree_block(rec)),
                            ("## Columnar ingress", ingress_block(rec)),
                            ("## Reconnect storm",
-                            reconnect_storm_block(rec))):
+                            reconnect_storm_block(rec)),
+                           ("## Durability", durability_block(rec))):
         if extra is not None:
             updated = update_section(updated, heading, extra)
     if write:

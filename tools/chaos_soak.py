@@ -47,6 +47,7 @@ Usage::
 
     python tools/chaos_soak.py --seed 7 --steps 400 --clients 4
     python tools/chaos_soak.py --seed 7 --quick      # the tier-1 profile
+    python tools/chaos_soak.py --quick --corrupt     # + seeded disk rot
 """
 
 from __future__ import annotations
@@ -63,15 +64,21 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
+# sibling tools (log_scrub) are importable regardless of how this module
+# was loaded (CLI, pytest importlib spec, bench subprocess)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 from fluidframework_tpu.core.protocol import MessageType          # noqa: E402
 from fluidframework_tpu.drivers.resilient import ResilientConnection  # noqa: E402,E501
 from fluidframework_tpu.server.ingress import AlfredServer        # noqa: E402
+from fluidframework_tpu.server.oplog import (                     # noqa: E402
+    OplogCorruptionError, scan_chained_spill,
+)
 from fluidframework_tpu.server.tinylicious import LocalService    # noqa: E402
 from fluidframework_tpu.testing.chaos import OpGen                # noqa: E402
 from fluidframework_tpu.utils import flight_recorder              # noqa: E402
 from fluidframework_tpu.utils.faultpoints import (                # noqa: E402
-    SITE_DELI_MID_WINDOW, ProbabilisticPlan, armed,
+    SITE_DELI_MID_WINDOW, ProbabilisticPlan, armed, corrupt_file,
 )
 from fluidframework_tpu.utils.telemetry import REGISTRY           # noqa: E402
 
@@ -95,13 +102,53 @@ def _violate(kind: str, **evidence) -> None:
     raise SoakViolation(f"{kind}: {evidence}")
 
 
+def _inject_raw_corruption(spill_dir: str, rng: random.Random) -> dict:
+    """Corrupt ONE random raw-deltas spill segment (seeded) and assert
+    the checksum chain SEES it before anything could apply it.
+
+    Only the RAW log is targeted: its backlog is never re-fed on
+    recovery, so repair-by-truncation cannot lose an acked op and the
+    exactly-once audit stays meaningful. Only bitflip/splice are drawn —
+    a random truncation can land exactly on a line boundary, which is
+    indistinguishable from a benign crash torn-tail by design (the
+    summary chain anchor, not the local scan, owns that case).
+
+    Returns the evidence dict (kind, path, detected) — or ``detected:
+    None`` when no non-empty raw segment exists yet to corrupt."""
+    targets = sorted(
+        p for p in (os.path.join(spill_dir, n)
+                    for n in os.listdir(spill_dir)
+                    if n.startswith("rawdeltas-p") and n.endswith(".jsonl"))
+        if os.path.getsize(p) > 0)
+    if not targets:
+        return {"kind": None, "path": None, "detected": None}
+    path = targets[rng.randrange(len(targets))]
+    kind = ("bitflip", "splice")[rng.randrange(2)]
+    ev = corrupt_file(path, kind, rng)
+    if ev.get("skipped"):
+        return {**ev, "detected": None}
+    scan = scan_chained_spill(path)
+    detected = bool(scan["problems"]) or scan["torn"]
+    if detected:
+        REGISTRY.inc("soak_corruption_detected_total")
+    else:
+        # the whole point of the chain: injected rot MUST be visible
+        _violate("corruption_undetected", **{
+            k: v for k, v in ev.items()
+            if isinstance(v, (int, float, str, bool))})
+    return {**ev, "detected": detected}
+
+
 class _Cluster:
     """The server side of the soak: one LocalService + AlfredServer on a
     fixed port, restartable in place (crash + recover-from-spill)."""
 
-    def __init__(self, spill_dir: str, n_partitions: int = 2):
+    def __init__(self, spill_dir: str, n_partitions: int = 2,
+                 corrupt_mode: bool = False):
         self.spill_dir = spill_dir
         self.n_partitions = n_partitions
+        self.corrupt_mode = corrupt_mode
+        self.corruption_repairs = 0
         self.service = LocalService(n_partitions=n_partitions,
                                     spill_dir=spill_dir)
         self.server = AlfredServer(self.service).start_in_thread()
@@ -112,11 +159,27 @@ class _Cluster:
         """Kill the serving process (thread) without any shutdown
         courtesy, then recover the service from its spill and re-serve
         on the same port — what a supervisor restart looks like to the
-        clients (dead sockets, then a resync against a higher epoch)."""
+        clients (dead sockets, then a resync against a higher epoch).
+
+        In ``--corrupt`` mode a recovery refused for a checksum-chain
+        break (OplogCorruptionError — the injected rot was DETECTED, not
+        applied) runs the offline scrubber with ``--repair`` semantics
+        over the spill, then recovers again; outside corrupt mode the
+        error propagates (a clean soak must never see one)."""
         self.server.stop()
         self.service.close()
-        self.service = LocalService.recover(
-            self.spill_dir, n_partitions=self.n_partitions)
+        try:
+            self.service = LocalService.recover(
+                self.spill_dir, n_partitions=self.n_partitions)
+        except OplogCorruptionError:
+            if not self.corrupt_mode:
+                raise
+            import log_scrub
+            reports = log_scrub.scrub_tree(self.spill_dir, repair=True)
+            self.corruption_repairs += sum(
+                1 for r in reports if r.get("repaired"))
+            self.service = LocalService.recover(
+                self.spill_dir, n_partitions=self.n_partitions)
         self.server = AlfredServer(
             self.service, port=self.port).start_in_thread()
         self.restarts += 1
@@ -130,7 +193,7 @@ def run_soak(seed: int = 0, steps: int = 400, n_clients: int = 4,
              kill_p: float = 0.01, restarts: int = 3,
              crash_p: float = 0.002, stall_p: float = 0.01,
              stall_s: float = 0.005, spill_dir: Optional[str] = None,
-             idle_timeout: float = 30.0) -> dict:
+             idle_timeout: float = 30.0, corrupt: bool = False) -> dict:
     """Run one seeded soak; returns the report dict or raises
     :class:`SoakViolation` / :class:`TimeoutError`."""
     rng = random.Random(seed)
@@ -138,7 +201,7 @@ def run_soak(seed: int = 0, steps: int = 400, n_clients: int = 4,
     if spill_dir is None:
         tmp = tempfile.TemporaryDirectory(prefix="chaos_soak_")
         spill_dir = tmp.name
-    cluster = _Cluster(spill_dir)
+    cluster = _Cluster(spill_dir, corrupt_mode=corrupt)
     # restart schedule: distinct step indices drawn up front so the
     # run is replayable and the restart count is exact, not expected
     restart_at = set(rng.sample(range(steps // 4, steps),
@@ -153,6 +216,7 @@ def run_soak(seed: int = 0, steps: int = 400, n_clients: int = 4,
     uid_marker: Dict[str, Dict[int, str]] = {}   # doc → uid → marker
     t0 = time.perf_counter()
     kills = 0
+    corruptions: List[dict] = []
     try:
         with armed(plan):
             for i in range(n_clients):
@@ -182,6 +246,12 @@ def run_soak(seed: int = 0, steps: int = 400, n_clients: int = 4,
                     # let in-flight traffic settle a beat so the restart
                     # catches a mix of durable and in-flight ops
                     time.sleep(0.02)
+                    if corrupt:
+                        # rot the raw spill between the crash and the
+                        # recover — the window real disk damage lives in
+                        ev = _inject_raw_corruption(spill_dir, rng)
+                        if ev["detected"] is not None:
+                            corruptions.append(ev)
                     cluster.crash_restart()
             # drain: every submitted op must end acked (resubmission
             # across kills/restarts is the plane under test)
@@ -206,6 +276,10 @@ def run_soak(seed: int = 0, steps: int = 400, n_clients: int = 4,
             "restarts": cluster.restarts,
             "faultpoint_fires": sum(plan.fires.values()),
             "faultpoint_stalls": sum(plan.stalls.values()),
+            "corruptions_injected": len(corruptions),
+            "corruptions_detected": sum(
+                1 for ev in corruptions if ev["detected"]),
+            "corruption_repairs": cluster.corruption_repairs,
             "final_epoch": max(c.epoch for c in clients),
             "violations": 0,
             "elapsed_s": round(time.perf_counter() - t0, 3),
@@ -269,12 +343,18 @@ def main() -> None:
     ap.add_argument("--crash-p", type=float, default=0.002)
     ap.add_argument("--quick", action="store_true",
                     help="tier-1 profile: small, seeded, ~seconds")
+    ap.add_argument("--corrupt", action="store_true",
+                    help="inject seeded disk rot (bitflip/splice) into "
+                         "the raw spill before each restart; the run "
+                         "fails unless every corruption is detected by "
+                         "the checksum chain before apply")
     args = ap.parse_args()
     if args.quick:
         args.steps, args.clients, args.restarts = 150, 3, 3
     report = run_soak(seed=args.seed, steps=args.steps,
                       n_clients=args.clients, restarts=args.restarts,
-                      kill_p=args.kill_p, crash_p=args.crash_p)
+                      kill_p=args.kill_p, crash_p=args.crash_p,
+                      corrupt=args.corrupt)
     print(json.dumps(report, indent=2, sort_keys=True))
 
 

@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Offline integrity scrubber for the durable layer (ISSUE 10).
+
+Walks oplog spill segments and summary-generation manifests WITHOUT the
+owning process, verifying every byte the durability plane claims to
+protect:
+
+- ``*.jsonl`` spills (``oplog.PartitionedLog``): re-runs the checksum
+  chain (``<8-hex crc32 chain word> <json>``) line by line; reports the
+  first break with its record index and byte offset.
+- ``p*.log`` native segments (``native_oplog`` / ``native/oplog.cpp``):
+  re-parses the ``[u32 len][u32 crc32][payload]`` framing, verifies each
+  frame CRC, then the ``b"H"``-wrapped chain words across frames. This
+  catches what a bare reopen would SILENTLY truncate (the C scan stops
+  at the first bad frame and drops everything after it — acked records
+  included); the scrubber reports it instead.
+- summary generation stores (any directory holding
+  ``gen-*.manifest.json``): SHA-256 of each blob against its manifest
+  (``runtime.summarizer.SummaryGenerationStore``).
+
+``--repair`` truncates a corrupt log segment back to its last verified
+prefix (counting ``scrub_repairs_total``) and quarantines corrupt
+summary generations (rename to ``*.quarantine`` — the recovery ladder
+already skips unverifiable rungs; quarantining just makes the scrub
+idempotent). Torn tails (unterminated trailing junk — a crash artifact,
+not rot) are repaired the same way but reported separately.
+
+Usage::
+
+    python tools/log_scrub.py SPILL_DIR [...]
+    python tools/log_scrub.py --check SPILL_DIR      # exit 1 on breaks
+    python tools/log_scrub.py --repair SPILL_DIR
+    python tools/log_scrub.py --json SPILL_DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import os
+import struct
+import sys
+import zlib
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from fluidframework_tpu.server.oplog import (             # noqa: E402
+    chain_step, scan_chained_spill,
+)
+from fluidframework_tpu.runtime.summarizer import (       # noqa: E402
+    SummaryGenerationStore,
+)
+from fluidframework_tpu.utils.telemetry import REGISTRY   # noqa: E402
+
+
+def scrub_jsonl(path: str, repair: bool = False) -> dict:
+    """Verify one JSONL spill's checksum chain; optionally truncate to
+    the last verified prefix."""
+    scan = scan_chained_spill(path)
+    report = {
+        "path": path, "format": "jsonl",
+        "records": len(scan["records"]),
+        "verified_bytes": scan["good_end"],
+        "torn_tail": scan["torn"],
+        "problems": list(scan["problems"]),
+        "repaired": False,
+    }
+    if (scan["problems"] or scan["torn"]) and repair:
+        with open(path, "r+b") as f:
+            f.truncate(scan["good_end"])
+        report["repaired"] = True
+        REGISTRY.inc("scrub_repairs_total")
+    return report
+
+
+def scrub_native_segment(path: str, repair: bool = False) -> dict:
+    """Verify one native segment's frame CRCs + chain words; optionally
+    truncate to the last verified frame."""
+    with open(path, "rb") as f:
+        data = f.read()
+    problems: List[dict] = []
+    records = 0
+    chain = 0
+    good_end = 0
+    torn = False
+    off = 0
+    while off < len(data):
+        if off + 8 > len(data):
+            torn = True  # partial trailing header: crash artifact
+            break
+        ln, crc = struct.unpack_from("<II", data, off)
+        if off + 8 + ln > len(data):
+            torn = True  # partial trailing payload
+            break
+        payload = data[off + 8:off + 8 + ln]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            problems.append({"index": records, "offset": off,
+                             "reason": "frame crc mismatch"})
+            break
+        if payload[:1] == b"H":
+            stored = int.from_bytes(payload[1:5], "little")
+            if stored != chain_step(payload[5:], chain):
+                problems.append({"index": records, "offset": off,
+                                 "reason": "chain mismatch"})
+                break
+            chain = stored
+        # pre-chain record: chain carries forward unverified
+        records += 1
+        off += 8 + ln
+        good_end = off
+    report = {
+        "path": path, "format": "native",
+        "records": records,
+        "verified_bytes": good_end,
+        "torn_tail": torn,
+        "problems": problems,
+        "repaired": False,
+    }
+    if (problems or torn) and repair:
+        with open(path, "r+b") as f:
+            f.truncate(good_end)
+        report["repaired"] = True
+        REGISTRY.inc("scrub_repairs_total")
+    return report
+
+
+def scrub_generations(directory: str, repair: bool = False) -> dict:
+    """Verify every summary generation's manifest hash; optionally
+    quarantine failing rungs."""
+    store = SummaryGenerationStore(directory, keep=1 << 30)
+    problems = store.verify_all()
+    report = {
+        "path": directory, "format": "generations",
+        "records": len(store.generations()),
+        "problems": problems,
+        "repaired": False,
+    }
+    if problems and repair:
+        for p in problems:
+            gen = p["generation"]
+            for fmt in (store._BLOB, store._MANIFEST):
+                src = os.path.join(directory, fmt.format(gen))
+                if os.path.exists(src):
+                    os.replace(src, src + ".quarantine")
+        report["repaired"] = True
+        REGISTRY.inc("scrub_repairs_total")
+    return report
+
+
+def scrub_tree(root: str, repair: bool = False) -> List[dict]:
+    """Walk ``root`` and scrub everything recognizable. A single file
+    path is scrubbed directly by extension."""
+    reports: List[dict] = []
+    if os.path.isfile(root):
+        if root.endswith(".jsonl"):
+            return [scrub_jsonl(root, repair)]
+        if root.endswith(".log"):
+            return [scrub_native_segment(root, repair)]
+        return []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if any(fnmatch.fnmatch(n, "gen-*.manifest.json")
+               for n in filenames):
+            reports.append(scrub_generations(dirpath, repair))
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            if name.endswith(".jsonl"):
+                reports.append(scrub_jsonl(path, repair))
+            elif fnmatch.fnmatch(name, "p*.log"):
+                reports.append(scrub_native_segment(path, repair))
+    return reports
+
+
+def summarize_reports(reports: List[dict]) -> dict:
+    """Roll a scrub run up to the numbers CI gates on."""
+    return {
+        "files": len(reports),
+        "records": sum(r.get("records", 0) for r in reports),
+        "chain_breaks": sum(len(r.get("problems", [])) for r in reports),
+        "torn_tails": sum(1 for r in reports if r.get("torn_tail")),
+        "repaired": sum(1 for r in reports if r.get("repaired")),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline oplog/summary integrity scrubber "
+                    "(see module docstring)")
+    ap.add_argument("paths", nargs="+",
+                    help="spill dirs, segment files, or generation dirs")
+    ap.add_argument("--repair", action="store_true",
+                    help="truncate corrupt segments to the last verified "
+                         "prefix; quarantine corrupt summary generations")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if any chain break was found")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+    reports: List[dict] = []
+    for path in args.paths:
+        reports.extend(scrub_tree(path, repair=args.repair))
+    summary = summarize_reports(reports)
+    if args.as_json:
+        print(json.dumps({"summary": summary, "reports": reports},
+                         indent=2, sort_keys=True))
+    else:
+        for r in reports:
+            status = "OK"
+            if r.get("problems"):
+                p = r["problems"][0]
+                status = (f"BREAK at record {p.get('index', '?')} "
+                          f"byte {p.get('offset', '?')} "
+                          f"({p.get('reason', '?')})")
+            elif r.get("torn_tail"):
+                status = "torn tail"
+            if r.get("repaired"):
+                status += " [repaired]"
+            print(f"{r['path']}: {r.get('records', 0)} records, {status}")
+        print(f"scrubbed {summary['files']} files, "
+              f"{summary['records']} records: "
+              f"{summary['chain_breaks']} chain breaks, "
+              f"{summary['torn_tails']} torn tails, "
+              f"{summary['repaired']} repaired")
+    if args.check and summary["chain_breaks"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -264,6 +264,46 @@ def judge_resilience(rounds: List[dict]) -> List[dict]:
                         "docs/RESILIENCE.md")}]
 
 
+def judge_durability(rounds: List[dict],
+                     spill_dir: Optional[str] = None) -> List[dict]:
+    """Hard gate on durable-layer integrity (ISSUE 10): the newest
+    round's ``durability`` phase reports ``chain_breaks`` from a scrub
+    of its own spill — a correctness count like the resilience gate, so
+    any nonzero value (or an errored phase, recorded as −1) regresses
+    regardless of bands. With ``spill_dir`` the sentinel additionally
+    runs the offline scrubber over that directory right now
+    (``log_scrub --check`` semantics) and regresses on any break."""
+    out: List[dict] = []
+    if rounds:
+        dur = rounds[-1].get("durability")
+        if isinstance(dur, dict):
+            v = dur.get("chain_breaks")
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                ok = v == 0
+                out.append({
+                    "metric": "durability.chain_breaks",
+                    "verdict": FLAT if ok else REGRESS, "value": v,
+                    "expected": "0 (integrity invariant)",
+                    "delta_pct": None,
+                    "note": "bench spill verified clean" if ok
+                    else ("durability phase errored" if v < 0
+                          else "checksum chain broken — see "
+                               "docs/DURABILITY.md")})
+    if spill_dir:
+        import log_scrub
+        summary = log_scrub.summarize_reports(
+            log_scrub.scrub_tree(spill_dir))
+        ok = summary["chain_breaks"] == 0
+        out.append({
+            "metric": "scrub.chain_breaks",
+            "verdict": FLAT if ok else REGRESS,
+            "value": summary["chain_breaks"],
+            "expected": "0 (integrity invariant)", "delta_pct": None,
+            "note": f"scrubbed {summary['files']} files / "
+                    f"{summary['records']} records in {spill_dir}"})
+    return out
+
+
 def has_regression(verdicts: List[dict]) -> bool:
     return any(v["verdict"] == REGRESS for v in verdicts)
 
@@ -348,6 +388,9 @@ def main(argv=None) -> int:
                     help="refresh the ## Trajectory section in BENCHES.md")
     ap.add_argument("--json", action="store_true",
                     help="print verdicts as JSON instead of the table")
+    ap.add_argument("--spill-dir", default=None,
+                    help="also scrub this spill directory now and fail "
+                         "on any checksum-chain break")
     args = ap.parse_args(argv)
 
     rounds = load_trajectory(args.root)
@@ -359,6 +402,7 @@ def main(argv=None) -> int:
                      k_sigma=args.k_sigma)
     verdicts += judge_floors(rounds)
     verdicts += judge_resilience(rounds)
+    verdicts += judge_durability(rounds, spill_dir=args.spill_dir)
     failed = has_regression(verdicts)
     if args.json:
         print(json.dumps(verdicts, indent=2))
