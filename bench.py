@@ -1139,17 +1139,20 @@ def run():
         done.wait(timeout=600)
         rate = total / (time.perf_counter() - t0)
         pstats = srv.pipeline_stats()
+        dstats = srv.drain_stats()
         windows = srv.windows_flushed
         srv.stop()
         del ing_eng
-        return rate, pstats, windows
+        return rate, pstats, dstats, windows
 
     ingress_trials, ingress_stats, ingress_windows = [], None, 0
+    ingress_drain = None
     for _t in range(3):
-        rate, pstats, windows = _ingress_trial()
+        rate, pstats, dstats, windows = _ingress_trial()
         ingress_trials.append(rate)
         if rate >= max(ingress_trials):
             ingress_stats, ingress_windows = pstats, windows
+            ingress_drain = dstats
     ingress_trials.sort()
     columnar_ingress_ops_per_sec = ingress_trials[-1]
     rtt_phases["after_ingress"] = round(rtt_now(), 1)
@@ -1629,6 +1632,14 @@ def run():
         "columnar_ingress_trials": [round(t, 1) for t in ingress_trials],
         "columnar_ingress_windows": ingress_windows,
         "columnar_ingress_pipeline": ingress_stats,
+        # whole-buffer batch decode evidence (ISSUE 15): decode-stage
+        # p50 per drain pass, bytes drained per pass, and which tier
+        # (native libingress.so vs numpy fallback) served
+        "ingress_decode_p50_ms": ingress_drain["decode_p50_ms"],
+        "ingress_drained_bytes_per_pass":
+            ingress_drain["bytes_per_pass_p50"],
+        "ingress_drain_passes": ingress_drain["passes"],
+        "ingress_decode_tier": ingress_drain["tier"],
         # resilience under load (ISSUE 9): the seeded reconnect storm's
         # throughput/latency plus the invariant-violation count the
         # perf sentinel gates on

@@ -17,18 +17,24 @@ import numpy as np
 
 
 def main(n_clients: int = 8, docs_per: int = 1024, waves: int = 24,
-         window_rows: int = 4096):
+         window_rows: int = 4096, pipeline_depth: int = 3,
+         decode: str = None):
     from fluidframework_tpu.server.columnar_ingress import (
         ColumnarAlfred, ColumnarClient, _OP_DTYPE,
     )
     from fluidframework_tpu.server.serving import StringServingEngine
 
+    if decode is None:
+        # FLUID_INGRESS_DECODE=numpy measures the always-available
+        # fallback tier on its own (the 45k floor's subject)
+        decode = os.environ.get("FLUID_INGRESS_DECODE", "auto")
     n_docs = n_clients * docs_per
     eng = StringServingEngine(n_docs=n_docs, capacity=256,
                               batch_window=10 ** 9, compact_every=10 ** 9,
                               sequencer="native")
     srv = ColumnarAlfred(eng, window_min_rows=window_rows,
-                         window_ms=2.0).start_in_thread()
+                         window_ms=2.0, pipeline_depth=pipeline_depth,
+                         decode=decode).start_in_thread()
 
     total = n_clients * docs_per * waves
     acked = [0] * n_clients
@@ -72,6 +78,8 @@ def main(n_clients: int = 8, docs_per: int = 1024, waves: int = 24,
     done.wait(timeout=600)
     elapsed = time.perf_counter() - t0
 
+    ds = srv.drain_stats()
+    ps = srv.pipeline_stats()
     print(json.dumps({
         "metric": "columnar_ingress_ops_per_sec",
         "value": round(total / elapsed, 1),
@@ -82,6 +90,12 @@ def main(n_clients: int = 8, docs_per: int = 1024, waves: int = 24,
         "windows": srv.windows_flushed,
         "ops_per_window": round(total / max(srv.windows_flushed, 1), 1),
         "evictions": srv.evictions,
+        "decode_tier": ds["tier"],
+        "decode_p50_ms": ds["decode_p50_ms"],
+        "drained_bytes_per_pass": ds["bytes_per_pass_p50"],
+        "drain_passes": ds["passes"],
+        "pipeline_depth": pipeline_depth,
+        "pipeline": ps,
         "transport": "tcp-localhost width-coded binary",
     }))
     srv.stop()

@@ -15,6 +15,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 TARGETS = {
     "libdeli.so": ["sequencer.cpp"],
     "liboplog.so": ["oplog.cpp"],
+    "libingress.so": ["ingress.cpp"],
 }
 
 

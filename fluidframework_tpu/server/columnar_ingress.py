@@ -29,11 +29,21 @@ crc32):
   width-coded like everything else (one small shared table per frame,
   u8 indices per op).
 
-Windowing: ops queue per doc row; the flusher takes the HEAD op of every
-pending row (per-doc order preserved; O = 1 column per window) whenever
-``window_min_rows`` rows are waiting or ``window_ms`` elapsed — one
-sequencer call + one device dispatch per window regardless of how many
-sockets fed it.
+Ingest path (ISSUE 15, accumulate-then-drain): per-client readers do NOT
+parse frames — they append raw ``recv`` chunks to a per-connection
+growable buffer and poke the flusher. A drain pass then decodes EVERY
+connection's accumulated bytes at once: frame split + crc verify
+(``native/ingress.cpp`` fast tier, numpy/zlib fallback), op records
+gathered into contiguous int32 planes, per-frame payload tables interned
+across the pass, and the whole backlog carved into unique-row windows
+(stable sort by row + per-row occurrence level — per-doc FIFO across
+windows is the sort's stability) that feed ``ingest_planes`` directly,
+through the ``PipelinedIngestExecutor`` when ``pipeline_depth > 0``.
+Decode cost scales with bytes drained, not frames seen. Control (``J``)
+frames and all resilience contracts (join/resume, epoch, dup_ack via the
+durable dedup ledger, torn-frame recovery — a partial frame simply stays
+buffered, backpressure) keep their slow-path semantics unchanged; see
+docs/INGRESS.md.
 """
 
 from __future__ import annotations
@@ -43,6 +53,7 @@ import json
 import socket
 import struct
 import threading
+import time
 import zlib
 from collections import deque
 from typing import Dict, List, Optional, Tuple
@@ -53,13 +64,27 @@ from ..core.protocol import ColumnarWireKind
 from ..utils import tracing
 from ..utils.backoff import Backoff, retry
 from ..utils.telemetry import REGISTRY
+from . import native_ingress
 from .ingest_pipeline import PipelinedIngestExecutor
+from .wire import BufferedSocketReader
 
 _HDR = struct.Struct("<BI")
 _OP_DTYPE = np.dtype([("row", "<u2"), ("kind", "u1"), ("a0", "<u2"),
                       ("a1", "<u2"), ("tidx", "u1"), ("cseq", "<u4"),
                       ("ref", "<u4")])
 assert _OP_DTYPE.itemsize == 16
+
+_FT_J, _FT_B, _FT_R = ord("J"), ord("B"), ord("R")
+
+#: defensive bound on one frame's payload (matches wire.MAX_FRAME); the
+#: accumulate-then-drain door must bound how many bytes a single frame
+#: may hold hostage in the rx buffer
+MAX_PAYLOAD = native_ingress.MAX_PAYLOAD
+SCAN_BAD_CRC = native_ingress.SCAN_BAD_CRC
+SCAN_TOO_LARGE = native_ingress.SCAN_TOO_LARGE
+
+_K_INS = int(ColumnarWireKind.INSERT)
+_K_ANN = int(ColumnarWireKind.ANNOTATE)
 
 
 def encode_frame(ftype: bytes, payload: bytes) -> bytes:
@@ -112,7 +137,147 @@ def _recv_exact(sock, n: int) -> bytes:
     return buf
 
 
+# ------------------------------------------------------- batch decode core
+#
+# Pure functions shared by the drain pass, the reference decoder, and the
+# byte-split fuzz tests. The contract for all of them: no view of the
+# input buffer survives the call (the caller trims a live ``bytearray``
+# right after — a surviving numpy/memoryview export would make the resize
+# raise BufferError).
+
+def _py_split_frames(buf) -> Tuple[List[Tuple[int, int, int]], int, int]:
+    """Numpy-tier frame splitter: scan ``buf`` for complete
+    ``[u8 type | u32 len | payload | u32 crc32]`` frames. Same contract
+    as ``native_ingress.scan`` (see ``split_frames``)."""
+    frames: List[Tuple[int, int, int]] = []
+    off, n, status = 0, len(buf), 0
+    mv = memoryview(buf)
+    try:
+        # 5 buffered bytes = a full header: enough to vet the length
+        # field (oversized frames fault before their payload arrives)
+        while n - off >= 5:
+            ftype, length = _HDR.unpack_from(buf, off)
+            if length > MAX_PAYLOAD:
+                status = SCAN_TOO_LARGE
+                break
+            total = 5 + length + 4
+            if n - off < total:
+                break  # torn frame: wait for more bytes
+            (crc,) = struct.unpack_from("<I", buf, off + 5 + length)
+            if zlib.crc32(mv[off + 5:off + 5 + length]) != crc:
+                status = SCAN_BAD_CRC
+                break
+            frames.append((ftype, off + 5, length))
+            off += total
+    finally:
+        mv.release()
+    return frames, off, status
+
+
+def split_frames(buf, native: Optional[bool] = None
+                 ) -> Tuple[List[Tuple[int, int, int]], int, int]:
+    """Split an accumulated rx buffer into complete CRC-valid frames.
+
+    Returns ``(frames, consumed, status)``: ``frames`` holds
+    ``(ftype, payload_off, payload_len)`` per frame, ``consumed`` the
+    bytes they cover (a trailing partial frame stays in the buffer for
+    the next drain — torn-frame recovery is exactly this), and
+    ``status`` is 0 / SCAN_BAD_CRC / SCAN_TOO_LARGE. On a poisoned frame
+    the scan stops AT it: the good prefix is still returned so earlier
+    frames take effect before the connection is faulted, matching the
+    per-frame door's ordering."""
+    if native is None:
+        native = native_ingress.available()
+    if native:
+        return native_ingress.scan(buf)
+    return _py_split_frames(buf)
+
+
+def parse_op_tables(payload, rich: bool
+                    ) -> Tuple[List[str], List[dict], int]:
+    """Parse an op frame's payload tables (text table; props table when
+    ``rich``): returns ``(texts, props, rec_off)`` where ``rec_off`` is
+    the byte offset of the 16-byte record section. Raises with the
+    protocol's established diagnostics on malformed tables or a ragged
+    record section. Accepts bytes or memoryview."""
+    try:
+        n_texts = payload[0]
+    except IndexError:
+        raise IndexError("index out of range") from None
+    off = 1
+    texts: List[str] = []
+    for _ in range(n_texts):
+        (ln,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        texts.append(bytes(payload[off:off + ln]).decode())
+        off += ln
+    props: List[dict] = []
+    if rich:
+        try:
+            n_props = payload[off]
+        except IndexError:
+            raise IndexError("index out of range") from None
+        off += 1
+        for _ in range(n_props):
+            (ln,) = struct.unpack_from("<H", payload, off)
+            off += 2
+            p = json.loads(bytes(payload[off:off + ln]))
+            off += ln
+            if not isinstance(p, dict) or len(p) != 1:
+                raise ValueError("props entries must be single-key dicts")
+            props.append(p)
+    if (len(payload) - off) % _OP_DTYPE.itemsize:
+        raise ValueError("record section not a whole number "
+                         "of op records")
+    return texts, props, off
+
+
+def _validate_op_planes(kind: np.ndarray, tidx: np.ndarray, rich: bool,
+                        n_texts: int, n_props: int) -> Optional[str]:
+    """One frame's whole-frame validation on its gathered planes — the
+    vectorized twin of the per-frame decoder's checks, byte-for-byte the
+    same diagnostics. Returns the reject message or None."""
+    top = _K_ANN if rich else int(ColumnarWireKind.REMOVE)
+    if kind.size and int(kind.max()) > top:
+        return "op kind out of range for this frame type"
+    ins = kind == _K_INS
+    if ins.any() and (n_texts == 0 or int(tidx[ins].max()) >= n_texts):
+        return "tidx out of text-table range"
+    ann = kind == _K_ANN
+    if ann.any() and (n_props == 0 or int(tidx[ann].max()) >= n_props):
+        return "tidx out of props-table range"
+    return None
+
+
+def reference_decode_op_frame(payload: bytes, rich: bool
+                              ) -> Tuple[List[str], List[dict],
+                                         np.ndarray]:
+    """The retired per-frame decoder, kept as the batch path's oracle:
+    parse + validate ONE op frame exactly like the pre-drain door did
+    (whole-frame reject semantics, same diagnostics). Returns
+    ``(texts, props, ops)`` or raises. The byte-split fuzz pins the
+    drain decoder against this on every cut offset."""
+    texts, props, off = parse_op_tables(payload, rich)
+    ops = np.frombuffer(payload, dtype=_OP_DTYPE, offset=off)
+    bad = _validate_op_planes(ops["kind"].astype(np.int32),
+                              ops["tidx"].astype(np.int32), rich,
+                              len(texts), len(props))
+    if bad is not None:
+        raise ValueError(bad)
+    return texts, props, ops
+
+
+#: plane names a drained part carries (all 1-D int32, equal length)
+_PLANES = ("row", "kind", "a0", "a1", "gidx", "cseq", "ref", "client")
+
+
 class _ColSession:
+    """One accepted socket. The reader ONLY accumulates: raw recv chunks
+    append to ``rx`` and poke the server's flusher — every byte of
+    protocol decode happens in the drain pass. Outbound frames ride a
+    bounded queue (slow-client policy: evict, as the reference
+    Broadcaster does)."""
+
     def __init__(self, server: "ColumnarAlfred", reader, writer):
         self.server = server
         self.reader = reader
@@ -120,32 +285,36 @@ class _ColSession:
         self.client_id: Optional[int] = None
         self.out: asyncio.Queue = asyncio.Queue(maxsize=4096)
         self.evicted = False
+        self.dead = False
+        self.rx = bytearray()
+        #: cleared while the rx buffer is over budget — reader
+        #: backpressure until a drain trims it
+        self._resume = asyncio.Event()
+        self._resume.set()
 
     async def run(self) -> None:
+        srv = self.server
+        srv._sessions.add(self)
         sender = asyncio.create_task(self._send_loop())
         try:
-            while True:
+            while not self.dead:
                 try:
-                    hdr = await self.reader.readexactly(_HDR.size)
-                    ftype, length = _HDR.unpack(hdr)
-                    payload = await self.reader.readexactly(length)
-                    (crc,) = struct.unpack(
-                        "<I", await self.reader.readexactly(4))
-                except (asyncio.IncompleteReadError, ConnectionError):
+                    chunk = await self.reader.read(srv.read_chunk)
+                except (ConnectionError, OSError):
                     break
-                if crc != zlib.crc32(payload):
-                    self._error("bad crc")
+                if not chunk:
                     break
-                if not self._handle(ftype, payload):
-                    # fatal error frames were written DIRECTLY (the
-                    # sender task is about to die with its queue) —
-                    # flush them before closing
-                    try:
-                        await self.writer.drain()
-                    except (ConnectionError, OSError):
-                        pass
-                    break
+                self.rx += chunk
+                srv._note_rx(self, len(chunk))
+                if len(self.rx) >= srv.max_rx_bytes:
+                    self._resume.clear()
+                    srv._wake_soon()
+                    await self._resume.wait()
         finally:
+            srv._sessions.discard(self)
+            # complete frames that arrived before EOF still drain (the
+            # per-frame door processed them too); their acks go to a
+            # closed socket, which resubmit+dedup absorbs
             sender.cancel()
             self.writer.close()
 
@@ -156,7 +325,7 @@ class _ColSession:
             await self.writer.drain()
 
     def _push(self, frame: bytes) -> None:
-        if self.evicted:
+        if self.evicted or self.dead:
             return
         try:
             self.out.put_nowait(frame)
@@ -170,119 +339,85 @@ class _ColSession:
     def _push_json(self, obj: dict) -> None:
         self._push(encode_json(obj))
 
-    def _error(self, message: str) -> None:
-        """Fatal diagnostic: write DIRECTLY (run() drains before close —
-        a queued frame would die with the cancelled sender task)."""
+    def _fatal(self, message: Optional[str]) -> None:
+        """Protocol-fatal close from the drain pass: flush whatever the
+        sender has queued (acks for frames that preceded the poison),
+        append the diagnostic, close. ``message=None`` is the orderly
+        ``bye`` close (no diagnostic). transport.close() flushes the
+        written bytes before tearing down."""
+        if self.dead:
+            return
+        self.dead = True
         try:
-            self.writer.write(encode_json({"t": "error",
-                                           "message": message}))
-        except (ConnectionError, OSError):
+            while not self.out.empty():
+                self.writer.write(self.out.get_nowait())
+            if message is not None:
+                self.writer.write(encode_json({"t": "error",
+                                               "message": message}))
+        except (ConnectionError, OSError, RuntimeError,
+                asyncio.QueueEmpty):
             pass
+        try:
+            self.writer.close()
+        except (ConnectionError, OSError, RuntimeError):
+            pass
+        self._resume.set()   # wake a paused reader so run() can exit
 
-    def _handle(self, ftype: int, payload: bytes) -> bool:
+    def _handle_json(self, payload: bytes) -> Optional[str]:
+        """One control frame, slow path (join/resume/bye) — semantics
+        unchanged from the per-frame door. Returns None to keep serving,
+        or a close reason ("" = orderly bye, non-empty = diagnostic)."""
         srv = self.server
-        if ftype == ord("J"):
-            req = json.loads(payload)
-            if req.get("t") == "join":
-                resume = req.get("client_id")
-                if self.client_id is None and resume is not None:
-                    # session resumption: the client reclaims its prior
-                    # identity so the sequencer's dedup cursor still
-                    # applies to its resubmits (a fresh id would turn
-                    # every resend into a first-time op)
-                    self.client_id = int(resume)
-                    srv._next_client = max(srv._next_client,
-                                           self.client_id + 1)
-                    REGISTRY.inc("session_reconnects_total")
-                if self.client_id is None:
-                    self.client_id = srv._next_client
-                    srv._next_client += 1
-                rows = {}
-                lcs = {}
-                for d in req["docs"]:
-                    if not srv.engine.is_member(d, self.client_id):
-                        # re-joining a still-seated client would RESET its
-                        # dedup cursor (client_join re-seats): resumed
-                        # members keep their seat
-                        srv.engine.connect(d, self.client_id)
-                    rows[d] = srv.engine.doc_row(d)
-                    lcs[d] = srv.engine.last_client_seq(d, self.client_id)
-                self._push_json({"t": "joined",
-                                 "client_id": self.client_id,
-                                 "rows": rows, "lcs": lcs,
-                                 "epoch": srv.epoch})
-                return True
-            if req.get("t") == "bye":
-                return False
-            self._error(f"unknown {req.get('t')!r}")
-            return False
-        if ftype in (ord("B"), ord("R")):
+        req = json.loads(payload)
+        if req.get("t") == "join":
+            resume = req.get("client_id")
+            if self.client_id is None and resume is not None:
+                # session resumption: the client reclaims its prior
+                # identity so the sequencer's dedup cursor still
+                # applies to its resubmits (a fresh id would turn
+                # every resend into a first-time op)
+                self.client_id = int(resume)
+                srv._next_client = max(srv._next_client,
+                                       self.client_id + 1)
+                REGISTRY.inc("session_reconnects_total")
             if self.client_id is None:
-                self._error("join first")
-                return False
-            rich = ftype == ord("R")
-            # validate the WHOLE frame before anything enqueues: a frame
-            # rejected half-way would leave earlier ops queued and later
-            # ones dropped (a silent per-doc gap)
-            try:
-                n_texts = payload[0]
-                off = 1
-                texts = []
-                for _ in range(n_texts):
-                    (ln,) = struct.unpack_from("<H", payload, off)
-                    off += 2
-                    texts.append(payload[off:off + ln].decode())
-                    off += ln
-                props: List[dict] = []
-                if rich:
-                    n_props = payload[off]
-                    off += 1
-                    for _ in range(n_props):
-                        (ln,) = struct.unpack_from("<H", payload, off)
-                        off += 2
-                        p = json.loads(payload[off:off + ln])
-                        off += ln
-                        if not isinstance(p, dict) or len(p) != 1:
-                            raise ValueError(
-                                "props entries must be single-key dicts")
-                        props.append(p)
-                if (len(payload) - off) % _OP_DTYPE.itemsize:
-                    raise ValueError("record section not a whole number "
-                                     "of op records")
-                ops = np.frombuffer(payload, dtype=_OP_DTYPE, offset=off)
-                top = int(ColumnarWireKind.ANNOTATE) if rich \
-                    else int(ColumnarWireKind.REMOVE)
-                if int(ops["kind"].max(initial=0)) > top:
-                    raise ValueError("op kind out of range for this "
-                                     "frame type")
-                ins = ops["kind"] == int(ColumnarWireKind.INSERT)
-                if ins.any() and (
-                        n_texts == 0
-                        or int(ops["tidx"][ins].max()) >= n_texts):
-                    raise ValueError("tidx out of text-table range")
-                ann = ops["kind"] == int(ColumnarWireKind.ANNOTATE)
-                if ann.any() and (
-                        not props
-                        or int(ops["tidx"][ann].max()) >= len(props)):
-                    raise ValueError("tidx out of props-table range")
-            except (ValueError, IndexError, struct.error,
-                    UnicodeDecodeError) as e:
-                self._error(f"malformed op frame: {e}")
-                return False
-            srv._enqueue_ops(self, texts, ops, props)
-            return True
-        self._error("unknown frame type")
-        return False
+                self.client_id = srv._next_client
+                srv._next_client += 1
+            rows = {}
+            lcs = {}
+            for d in req["docs"]:
+                if not srv.engine.is_member(d, self.client_id):
+                    # re-joining a still-seated client would RESET its
+                    # dedup cursor (client_join re-seats): resumed
+                    # members keep their seat
+                    srv.engine.connect(d, self.client_id)
+                rows[d] = srv.engine.doc_row(d)
+                lcs[d] = srv.engine.last_client_seq(d, self.client_id)
+            self._push_json({"t": "joined",
+                             "client_id": self.client_id,
+                             "rows": rows, "lcs": lcs,
+                             "epoch": srv.epoch})
+            return None
+        if req.get("t") == "bye":
+            return ""
+        return f"unknown {req.get('t')!r}"
 
 
 class ColumnarAlfred:
     """Binary columnar ingress over a ``StringServingEngine``: aggregates
     every connection's ops into per-window planes, one sequencer call +
-    one device dispatch per window (the Alfred→Kafka batching role)."""
+    one device dispatch per window (the Alfred→Kafka batching role).
+
+    ISSUE 15: sockets accumulate, the flusher drains — see the module
+    docstring for the decode pipeline. ``decode`` picks the drain tier:
+    ``"auto"`` (native when ``libingress.so`` built, else numpy),
+    ``"native"`` (require it), ``"numpy"`` (force the fallback)."""
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  window_min_rows: int = 512, window_ms: float = 2.0,
-                 pipeline_depth: int = 2, epoch: int = 0):
+                 pipeline_depth: int = 2, epoch: int = 0,
+                 decode: str = "auto", max_rx_bytes: int = 8 << 20,
+                 read_chunk: int = 256 << 10):
         self.engine = engine
         self.host = host
         self.port = port
@@ -297,15 +432,35 @@ class ColumnarAlfred:
         # the durable append). 0 = the serial one-round-trip-per-window
         # path.
         self.pipeline_depth = pipeline_depth
+        self.max_rx_bytes = max_rx_bytes
+        self.read_chunk = read_chunk
+        if decode == "native" and not native_ingress.available():
+            raise RuntimeError("decode='native' but libingress.so "
+                               "unavailable")
+        self._use_native = (native_ingress.available()
+                            if decode == "auto" else decode == "native")
         self.evictions = 0
         self.windows_flushed = 0
         self.ops_ingested = 0
+        self.drain_passes = 0
+        self.drained_bytes = 0
+        self._drain_ms: deque = deque(maxlen=512)
+        self._drain_bytes: deque = deque(maxlen=512)
         self._next_client = 1
-        # per doc-row FIFO of (session, text, kind, a0, a1, tidx→text,
-        # cseq, ref); the flusher pops one head per row per window
-        self._pending: Dict[int, deque] = {}
-        self._pending_rows: deque = deque()   # rows with work, FIFO
+        self._sessions: set = set()
+        #: sessions with undrained rx bytes (dict = ordered set)
+        self._dirty: Dict[_ColSession, None] = {}
+        self._rx_backlog = 0
+        self._wake_bytes = max(1, window_min_rows) * _OP_DTYPE.itemsize
+        #: decoded-but-unwindowed parts from the current drain pass
+        self._parts: List[dict] = []
         self._pending_ops = 0
+        # pass-scoped payload interners: frame tables dedupe across every
+        # connection in the pass; windows re-table compacted slices
+        self._texts: List[str] = []
+        self._text_of: Dict[str, int] = {}
+        self._props: List[dict] = []
+        self._prop_of: Dict[Tuple, int] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._wake: Optional[asyncio.Event] = None
         self._executor: Optional[PipelinedIngestExecutor] = None
@@ -315,128 +470,307 @@ class ColumnarAlfred:
 
     # ------------------------------------------------------------ ingest side
 
-    def _enqueue_ops(self, session: _ColSession, texts: List[str],
-                     ops: np.ndarray, props: List[dict] = ()) -> None:
-        pend = self._pending
-        queued = 0
-        for o in ops:
-            row = int(o["row"])
-            if row >= self.engine.n_docs:
-                session._push_json({"t": "error",
-                                    "message": f"row {row} out of range"})
-                continue
-            q = pend.get(row)
-            if q is None:
-                q = pend[row] = deque()
-            if not q:
-                self._pending_rows.append(row)
-            k = int(o["kind"])
-            # the queued payload is the TEXT for inserts, the single-key
-            # props DICT for annotates (frame tables don't outlive the
-            # frame; the flusher re-tables per window)
-            payload = texts[int(o["tidx"])] \
-                if k == int(ColumnarWireKind.INSERT) else \
-                props[int(o["tidx"])] \
-                if k == int(ColumnarWireKind.ANNOTATE) else ""
-            q.append((session, payload, k, int(o["a0"]),
-                      int(o["a1"]), int(o["cseq"]), int(o["ref"])))
-            queued += 1
-        self._pending_ops += queued
-        if len(self._pending_rows) >= self.window_min_rows \
-                and self._wake is not None:
+    def _note_rx(self, sess: _ColSession, n: int) -> None:
+        """Reader hook: bytes landed on a session. Wake the flusher once
+        roughly a window's worth of records is waiting; smaller dribbles
+        ride the ``window_ms`` tick (the old enqueue path's pacing)."""
+        self._dirty[sess] = None
+        self._rx_backlog += n
+        if self._rx_backlog >= self._wake_bytes and self._wake is not None:
             self._wake.set()
 
-    def _flush_window(self, limit: Optional[int] = None) -> int:
-        """One aggregation window: the head op of (up to ``limit``)
-        pending rows → ONE ``ingest_planes`` dispatch; acks fan back per
-        session. Steady-state windows are exactly ``window_min_rows``
-        rows (one compiled dispatch shape); only timeout flushes vary."""
-        n = len(self._pending_rows)
-        if limit is not None:
-            n = min(n, limit)
-        if not n:
-            return 0
-        rows = np.empty(n, np.int32)
-        kind = np.empty((n, 1), np.int32)
-        a0 = np.empty((n, 1), np.int32)
-        a1 = np.empty((n, 1), np.int32)
-        tidx = np.zeros((n, 1), np.int32)
-        cseq = np.empty((n, 1), np.int32)
-        ref = np.empty((n, 1), np.int32)
-        client = np.empty((n, 1), np.int32)
-        sessions: List[_ColSession] = []
-        texts: List[str] = []
-        text_of: Dict[str, int] = {}
-        props: List[dict] = []
-        prop_of: Dict[Tuple, int] = {}
-        again: List[int] = []
-        k_ins = int(ColumnarWireKind.INSERT)
-        k_ann = int(ColumnarWireKind.ANNOTATE)
-        for j in range(n):
-            row = self._pending_rows.popleft()
-            q = self._pending[row]
-            sess, payload, k, x0, x1, cs, rf = q.popleft()
-            if q:
-                again.append(row)
-            rows[j] = row
-            kind[j, 0] = k
-            a0[j, 0] = x0
-            a1[j, 0] = x1
-            cseq[j, 0] = cs
-            ref[j, 0] = rf
-            client[j, 0] = sess.client_id
-            sessions.append(sess)
-            if k == k_ins:
-                h = text_of.get(payload)
-                if h is None:
-                    h = text_of[payload] = len(texts)
-                    texts.append(payload)
-                tidx[j, 0] = h
-            elif k == k_ann:
-                (key, value), = payload.items()
-                pk = (key, value if not isinstance(value, (dict, list))
-                      else json.dumps(value, sort_keys=True))
-                h = prop_of.get(pk)
-                if h is None:
-                    h = prop_of[pk] = len(props)
-                    props.append(payload)
-                tidx[j, 0] = h
-        self._pending_rows.extend(again)
-        self._pending_ops -= n
+    def _wake_soon(self) -> None:
+        if self._wake is not None:
+            self._wake.set()
+
+    def _intern_text(self, s: str) -> int:
+        h = self._text_of.get(s)
+        if h is None:
+            h = self._text_of[s] = len(self._texts)
+            self._texts.append(s)
+        return h
+
+    def _intern_prop(self, p: dict) -> int:
+        (key, value), = p.items()
+        pk = (key, value if not isinstance(value, (dict, list))
+              else json.dumps(value, sort_keys=True))
+        h = self._prop_of.get(pk)
+        if h is None:
+            h = self._prop_of[pk] = len(self._props)
+            self._props.append(p)
+        return h
+
+    def _drain(self) -> None:
+        """One whole-buffer decode pass over every dirty connection:
+        split frames, verify CRCs, gather op planes, intern tables —
+        cost scales with bytes drained, not frames seen."""
+        if not self._dirty:
+            return
+        t0 = time.perf_counter()
+        sessions = list(self._dirty)
+        self._dirty.clear()
+        self._rx_backlog = 0
+        total = 0
+        for sess in sessions:
+            if sess.dead or not sess.rx:
+                continue
+            total += self._drain_session(sess)
+        if total:
+            self._drain_ms.append((time.perf_counter() - t0) * 1e3)
+            self._drain_bytes.append(total)
+            self.drain_passes += 1
+            self.drained_bytes += total
+            REGISTRY.inc("columnar_drain_passes")
+            REGISTRY.inc("columnar_drained_bytes", total)
+
+    def _drain_session(self, sess: _ColSession) -> int:
+        rx = sess.rx
+        frames, consumed, status = split_frames(rx,
+                                                native=self._use_native)
+        fatal: Optional[str] = None
+        bye = False
+        # per op frame: (abs record offset, count, tmap, pmap, rich,
+        # client_id, n_texts, n_props) — gathered in ONE pass below
+        runs: List[tuple] = []
+        mv = memoryview(rx)
+        try:
+            for ftype, off, ln in frames:
+                if ftype == _FT_B or ftype == _FT_R:
+                    if sess.client_id is None:
+                        fatal = "join first"
+                        break
+                    rich = ftype == _FT_R
+                    try:
+                        texts, props, rec_off = parse_op_tables(
+                            mv[off:off + ln], rich)
+                    except (ValueError, IndexError, struct.error,
+                            UnicodeDecodeError) as e:
+                        fatal = f"malformed op frame: {e}"
+                        break
+                    tmap = np.array([self._intern_text(t) for t in texts],
+                                    np.int32)
+                    pmap = np.array([self._intern_prop(p) for p in props],
+                                    np.int32)
+                    runs.append((off + rec_off,
+                                 (ln - rec_off) // _OP_DTYPE.itemsize,
+                                 tmap, pmap, rich, sess.client_id,
+                                 len(texts), len(props)))
+                elif ftype == _FT_J:
+                    reason = sess._handle_json(bytes(mv[off:off + ln]))
+                    if reason is not None:
+                        bye, fatal = True, (reason or None)
+                        break
+                else:
+                    fatal = "unknown frame type"
+                    break
+            else:
+                if status == SCAN_BAD_CRC:
+                    fatal = "bad crc"
+                elif status == SCAN_TOO_LARGE:
+                    fatal = "frame too large"
+        finally:
+            mv.release()
+        if runs:
+            self._decode_runs(sess, rx, runs)
+        # no view of rx survives _decode_runs (planes are copies): the
+        # bytearray is free to resize
+        if fatal is not None or bye:
+            sess._fatal(fatal)
+            rx.clear()
+        else:
+            del rx[:consumed]
+            if not sess._resume.is_set() \
+                    and len(rx) < self.max_rx_bytes:
+                sess._resume.set()
+        return consumed
+
+    def _decode_runs(self, sess: _ColSession, rx: bytearray,
+                     runs: List[tuple]) -> None:
+        """Gather one session's validated op-frame runs into int32
+        planes, map per-frame table indices to pass-global interned ids,
+        and queue the part for windowing. Whole-frame reject semantics:
+        the first invalid frame faults the connection and discards
+        itself plus everything after it; earlier frames stand."""
+        if self._use_native:
+            planes = native_ingress.gather(rx, [(r[0], r[1])
+                                                for r in runs])
+            row, kind = planes["row"], planes["kind"]
+            a0, a1 = planes["a0"], planes["a1"]
+            tidx, cseq, ref = planes["tidx"], planes["cseq"], planes["ref"]
+        else:
+            views = [np.frombuffer(rx, _OP_DTYPE, count=r[1], offset=r[0])
+                     for r in runs]
+            rec = np.concatenate(views) if len(views) > 1 \
+                else views[0].copy()
+            del views
+            row = rec["row"].astype(np.int32)
+            kind = rec["kind"].astype(np.int32)
+            a0 = rec["a0"].astype(np.int32)
+            a1 = rec["a1"].astype(np.int32)
+            tidx = rec["tidx"].astype(np.int32)
+            cseq = rec["cseq"].astype(np.int32)
+            ref = rec["ref"].astype(np.int32)
+        gidx = np.zeros(row.size, np.int32)
+        client = np.empty(row.size, np.int32)
+        pos = 0
+        keep_until = row.size
+        fatal = None
+        for _ro, cnt, tmap, pmap, rich, cid, n_texts, n_props in runs:
+            sl = slice(pos, pos + cnt)
+            bad = _validate_op_planes(kind[sl], tidx[sl], rich,
+                                      n_texts, n_props)
+            if bad is not None:
+                fatal = f"malformed op frame: {bad}"
+                keep_until = pos
+                break
+            if tmap.size:
+                m = kind[sl] == _K_INS
+                if m.any():
+                    gidx[sl][m] = tmap[tidx[sl][m]]
+            if pmap.size:
+                m = kind[sl] == _K_ANN
+                if m.any():
+                    gidx[sl][m] = pmap[tidx[sl][m]]
+            client[sl] = cid
+            pos += cnt
+        if keep_until < row.size:
+            row, kind, a0, a1 = (x[:keep_until]
+                                 for x in (row, kind, a0, a1))
+            gidx, cseq, ref, client = (x[:keep_until]
+                                       for x in (gidx, cseq, ref, client))
+        # per-op row bound check: bad rows error individually and drop;
+        # the rest of the frame stands (NOT whole-frame — the row space
+        # is the server's, not the frame layout's)
+        oob = row >= self.engine.n_docs
+        if oob.any():
+            for r in row[oob].tolist():
+                sess._push_json({"t": "error",
+                                 "message": f"row {r} out of range"})
+            ok = ~oob
+            row, kind, a0, a1 = (x[ok] for x in (row, kind, a0, a1))
+            gidx, cseq, ref, client = (x[ok] for x in
+                                       (gidx, cseq, ref, client))
+        if row.size:
+            self._parts.append({"sess": sess, "row": row, "kind": kind,
+                                "a0": a0, "a1": a1, "gidx": gidx,
+                                "cseq": cseq, "ref": ref,
+                                "client": client})
+            self._pending_ops += int(row.size)
+        if fatal is not None:
+            sess._fatal(fatal)
+            rx.clear()
+
+    def _build_windows(self) -> List[dict]:
+        """Carve the pass's decoded backlog into unique-row windows:
+        stable sort by row, split by per-row occurrence level (level k =
+        every row's k-th pending op — per-doc FIFO is the sort's
+        stability), chunk levels to ``window_min_rows``. Each window
+        compacts its own text/props tables from the pass interner."""
+        parts = self._parts
+        if not parts:
+            return []
+        self._parts = []
+        tab: List[_ColSession] = []
+        idx_of: Dict[int, int] = {}
+        sessi_parts = []
+        for p in parts:
+            s = p["sess"]
+            i = idx_of.get(id(s))
+            if i is None:
+                i = idx_of[id(s)] = len(tab)
+                tab.append(s)
+            sessi_parts.append(np.full(p["row"].size, i, np.int32))
+        if len(parts) == 1:
+            f = {k: parts[0][k] for k in _PLANES}
+            sessi = sessi_parts[0]
+        else:
+            f = {k: np.concatenate([p[k] for p in parts])
+                 for k in _PLANES}
+            sessi = np.concatenate(sessi_parts)
+        row = f["row"]
+        n = row.size
+        order = np.argsort(row, kind="stable")
+        srow = row[order]
+        new = np.empty(n, bool)
+        new[0] = True
+        new[1:] = srow[1:] != srow[:-1]
+        starts = np.flatnonzero(new)
+        occ = np.arange(n) - np.repeat(starts,
+                                       np.diff(np.append(starts, n)))
+        lvl_order = np.argsort(occ, kind="stable")
+        cuts = np.flatnonzero(np.diff(occ[lvl_order])) + 1
+        chunks: List[np.ndarray] = []
+        for lvl in np.split(order[lvl_order], cuts):
+            for s in range(0, lvl.size, self.window_min_rows):
+                chunks.append(lvl[s:s + self.window_min_rows])
+        texts_g, props_g = self._texts, self._props
+        windows = []
+        for w in chunks:
+            kind_w = f["kind"][w]
+            gidx_w = f["gidx"][w]
+            tidx_w = np.zeros(w.size, np.int32)
+            ins = kind_w == _K_INS
+            texts_w: List[str] = []
+            if ins.any():
+                u, inv = np.unique(gidx_w[ins], return_inverse=True)
+                tidx_w[ins] = inv.astype(np.int32)
+                texts_w = [texts_g[i] for i in u.tolist()]
+            props_w: List[dict] = []
+            ann = kind_w == _K_ANN
+            if ann.any():
+                u, inv = np.unique(gidx_w[ann], return_inverse=True)
+                tidx_w[ann] = inv.astype(np.int32)
+                props_w = [props_g[i] for i in u.tolist()]
+            windows.append({
+                "rows": row[w], "kind": kind_w.reshape(-1, 1),
+                "a0": f["a0"][w].reshape(-1, 1),
+                "a1": f["a1"][w].reshape(-1, 1),
+                "tidx": tidx_w.reshape(-1, 1),
+                "cseq": f["cseq"][w].reshape(-1, 1),
+                "ref": f["ref"][w].reshape(-1, 1),
+                "client": f["client"][w].reshape(-1, 1),
+                "cseq_flat": f["cseq"][w], "sessi": sessi[w],
+                "texts": texts_w or [""], "props": props_w or None,
+                "tab": tab})
+        # the interners only feed this pass's windows, which now carry
+        # their own compacted tables — reset so they stay bounded
+        self._texts, self._text_of = [], {}
+        self._props, self._prop_of = [], {}
+        return windows
+
+    def _submit_window(self, w: dict) -> None:
+        n = int(w["rows"].size)
         if self._executor is not None:
             # pipelined front door: hand the window to the executor and
             # return — the NEXT window aggregates while this one packs/
             # sequences/dispatches; acks fan back from the done callback
             # only after the durable append commits (ack-after-durable)
             with tracing.TRACER.maybe_root_span(
-                    "columnar.submit_window", every=256, ops=int(n)):
+                    "columnar.submit_window", every=256, ops=n):
                 ticket = self._executor.submit(
-                    rows, client, cseq, ref, kind, a0, a1,
-                    texts=texts or [""], tidx=tidx,
-                    props=props or None)
+                    w["rows"], w["client"], w["cseq"], w["ref"],
+                    w["kind"], w["a0"], w["a1"], texts=w["texts"],
+                    tidx=w["tidx"], props=w["props"])
             self._waves_inflight += 1
             loop = getattr(self, "_loop", None) or \
                 asyncio.get_running_loop()
             ticket.add_done_callback(
-                lambda t: self._bounce_ack(loop, t, sessions, cseq,
-                                           rows))
+                lambda t: self._bounce_ack(loop, t, w))
         else:
             with tracing.TRACER.maybe_root_span(
-                    "columnar.flush_window", every=256, ops=int(n)):
+                    "columnar.flush_window", every=256, ops=n):
                 res = self.engine.ingest_planes(
-                    rows, client, cseq, ref, kind, a0, a1,
-                    texts=texts or [""], tidx=tidx,
-                    props=props or None)
-            self._fan_acks(sessions, cseq,
-                           np.asarray(res["seq"]).reshape(-1), rows)
+                    w["rows"], w["client"], w["cseq"], w["ref"],
+                    w["kind"], w["a0"], w["a1"], texts=w["texts"],
+                    tidx=w["tidx"], props=w["props"])
+            self._fan_acks(w, np.asarray(res["seq"]).reshape(-1))
         self.windows_flushed += 1
         self.ops_ingested += n
+        self._pending_ops -= n
         REGISTRY.inc("columnar_windows_flushed")
         REGISTRY.inc("columnar_ops_ingested", n)
-        return n
 
-    def _fan_acks(self, sessions: List[_ColSession], cseq: np.ndarray,
-                  seqs: np.ndarray, rows: np.ndarray) -> None:
+    def _fan_acks(self, w: dict, seqs: np.ndarray) -> None:
         """Fan a window's acks back, one frame per participating session.
 
         Runs AFTER the durable append (serial path: ingest_planes
@@ -447,32 +781,30 @@ class ColumnarAlfred:
         The frame carries a parallel ``rows`` list (acks keep their
         2-tuple shape for wire compatibility) so resilient clients can
         attribute each ack to a doc."""
-        per_sess: Dict[_ColSession, list] = {}
-        engine = self.engine
-        doc_of = engine._row_doc_id
-        for j, sess in enumerate(sessions):
-            cs, sq, row = int(cseq[j, 0]), int(seqs[j]), int(rows[j])
-            if sq > 0:
-                engine.note_acked(doc_of[row], sess.client_id, cs, sq)
-            per_sess.setdefault(sess, ([], []))
-            ack_l, row_l = per_sess[sess]
-            ack_l.append([cs, sq])
-            row_l.append(row)
-        for sess, (ack_l, row_l) in per_sess.items():
-            sess._push_json({"t": "acks", "acks": ack_l, "rows": row_l})
+        rows, cseq = w["rows"], w["cseq_flat"]
+        sessi, tab = w["sessi"], w["tab"]
+        self.engine.note_acked_planes(rows, w["client"].reshape(-1),
+                                      cseq, seqs)
+        order = np.argsort(sessi, kind="stable")
+        ss = sessi[order]
+        cuts = np.flatnonzero(np.diff(ss)) + 1
+        for g in np.split(order, cuts):
+            pairs = np.empty((g.size, 2), np.int64)
+            pairs[:, 0] = cseq[g]
+            pairs[:, 1] = seqs[g]
+            tab[int(sessi[g[0]])]._push_json(
+                {"t": "acks", "acks": pairs.tolist(),
+                 "rows": rows[g].tolist()})
 
-    def _bounce_ack(self, loop, ticket, sessions: List[_ColSession],
-                    cseq: np.ndarray, rows: np.ndarray) -> None:
+    def _bounce_ack(self, loop, ticket, w: dict) -> None:
         """Ticket done-callback: runs on the executor's log worker —
         bounce onto the event loop (session queues are loop-affine)."""
         try:
-            loop.call_soon_threadsafe(self._ack_wave, ticket, sessions,
-                                      cseq, rows)
+            loop.call_soon_threadsafe(self._ack_wave, ticket, w)
         except RuntimeError:
             pass   # loop already closed (shutdown race): acks are moot
 
-    def _ack_wave(self, ticket, sessions: List[_ColSession],
-                  cseq: np.ndarray, rows: np.ndarray) -> None:
+    def _ack_wave(self, ticket, w: dict) -> None:
         self._waves_inflight -= 1
         if self._capacity is not None:
             self._capacity.set()
@@ -480,21 +812,18 @@ class ColumnarAlfred:
         if err is not None:
             if self._pipeline_error is None:
                 self._pipeline_error = err
-            # dict.fromkeys: dedupe sessions, preserve order
-            for sess in dict.fromkeys(sessions):
-                sess._push_json({"t": "error",
-                                 "message": f"ingest failed: {err}"})
+            for i in np.unique(w["sessi"]).tolist():
+                w["tab"][i]._push_json(
+                    {"t": "error", "message": f"ingest failed: {err}"})
             if self._wake is not None:
                 self._wake.set()
             return
-        self._fan_acks(sessions, cseq,
-                       np.asarray(ticket.result()["seq"]).reshape(-1),
-                       rows)
+        self._fan_acks(w, np.asarray(ticket.result()["seq"]).reshape(-1))
 
     async def _wait_capacity(self) -> None:
         """Depth backpressure: park the flusher (event loop stays free to
-        aggregate more socket ops) until a wave's durable append frees an
-        in-flight slot."""
+        accumulate more socket bytes) until a wave's durable append frees
+        an in-flight slot."""
         if self._executor is None:
             return
         while self._waves_inflight >= self._executor.depth \
@@ -516,18 +845,18 @@ class ColumnarAlfred:
                 if self._pipeline_error is not None:
                     raise RuntimeError("pipelined ingest failed"
                                        ) from self._pipeline_error
-                while len(self._pending_rows) >= self.window_min_rows:
+                self._drain()
+                for w in self._build_windows():
                     await self._wait_capacity()
-                    self._flush_window(limit=self.window_min_rows)
-                if self._pending_rows:
-                    await self._wait_capacity()
-                    self._flush_window()
+                    if self._pipeline_error is not None:
+                        raise RuntimeError("pipelined ingest failed"
+                                           ) from self._pipeline_error
+                    self._submit_window(w)
             except Exception as e:   # poisoned engine / device fault:
                 # surface to every connected session, then stop serving
-                for row, q in self._pending.items():
-                    for sess, *_rest in q:
-                        sess._push_json({"t": "error",
-                                         "message": f"ingest failed: {e}"})
+                for sess in list(self._sessions):
+                    sess._push_json({"t": "error",
+                                     "message": f"ingest failed: {e}"})
                 raise
 
     # ----------------------------------------------------------- lifecycle
@@ -591,6 +920,18 @@ class ColumnarAlfred:
         ex = self._executor
         return None if ex is None else ex.stats()
 
+    def drain_stats(self) -> dict:
+        """Decode-stage evidence (bench.py / storm bench): p50 drain
+        pass latency, drained bytes per pass, pass count, decode tier."""
+        ms = sorted(self._drain_ms)
+        by = sorted(self._drain_bytes)
+        return {
+            "decode_p50_ms": round(ms[len(ms) // 2], 4) if ms else 0.0,
+            "bytes_per_pass_p50": int(by[len(by) // 2]) if by else 0,
+            "passes": self.drain_passes,
+            "drained_bytes": self.drained_bytes,
+            "tier": "native" if self._use_native else "numpy"}
+
 
 def connect_with_backoff(host: str, port: int, attempts: int = 5,
                          base_delay: float = 0.05,
@@ -616,11 +957,14 @@ def connect_with_backoff(host: str, port: int, attempts: int = 5,
 
 
 class ColumnarClient:
-    """Blocking-socket client for the columnar ingress (tests/bench)."""
+    """Blocking-socket client for the columnar ingress (tests/bench).
+    Reads go through a ``BufferedSocketReader`` (one large recv refills
+    a buffer the 3-read frame parser serves from)."""
 
     def __init__(self, host: str, port: int, connect_attempts: int = 5):
         self.sock = connect_with_backoff(host, port,
                                          attempts=connect_attempts)
+        self._rd = BufferedSocketReader(self.sock)
         self.client_id: Optional[int] = None
         self.rows: Dict[str, int] = {}
         self.lcs: Dict[str, int] = {}   # per-doc last accepted clientSeq
@@ -648,7 +992,7 @@ class ColumnarClient:
         self.sock.sendall(encode_op_batch(texts, ops, props=props))
 
     def recv_json(self) -> dict:
-        ftype, payload = read_frame(self.sock)
+        ftype, payload = read_frame(self._rd)
         assert ftype == ord("J"), ftype
         return json.loads(payload)
 

@@ -57,31 +57,38 @@ class _Session:
 
     async def run(self) -> None:
         sender = asyncio.create_task(self._send_loop())
+        # accumulate-then-drain: one large read per wakeup, the
+        # accumulator splits whatever frames it holds (partial frames
+        # stay buffered); frame decode cost stops scaling with frame
+        # count and the 2-reads-per-frame syscall tax goes away
+        acc = wire.FrameAccumulator()
         try:
             while True:
                 try:
-                    header = await self.reader.readexactly(
-                        wire.HEADER_SIZE)
-                except (asyncio.IncompleteReadError, ConnectionError):
+                    chunk = await self.reader.read(wire.READ_CHUNK)
+                except (ConnectionError, OSError):
                     break
-                try:
-                    length, crc = wire.decode_header(header)
-                    payload = await self.reader.readexactly(length)
-                    req = wire.decode_payload(payload, crc)
-                except (wire.WireError,
-                        asyncio.IncompleteReadError) as e:
-                    # corrupt frame: drop THIS connection, keep serving
-                    await self._error(str(e))
+                if not chunk:
                     break
+                stop = False
                 try:
-                    if not await self._handle(req):
-                        break
+                    for req in acc.feed(chunk):
+                        if not await self._handle(req):
+                            stop = True
+                            break
                 except CrashInjected:
                     # an armed fault plan killed the pipeline mid-request:
                     # from this client's view the server just died — drop
                     # the socket (resilient clients resync; the sequencer
                     # may have burned a clientSeq, which resync's
                     # last_client_seq renumbering absorbs)
+                    break
+                if stop:
+                    break
+                if acc.error is not None:
+                    # corrupt frame: the good prefix above already took
+                    # effect; drop THIS connection, keep serving
+                    await self._error(str(acc.error))
                     break
         finally:
             if self.conn is not None and self.conn.connected:
@@ -119,8 +126,14 @@ class _Session:
     async def _error(self, message: str) -> None:
         """Deliver an error frame DIRECTLY (the sender task is about to be
         cancelled when the session breaks — a queued frame would die with
-        it) so clients get a diagnostic, not a bare close."""
+        it) so clients get a diagnostic, not a bare close. Frames still
+        sitting in the outbound queue (e.g. broadcasts for ops decoded
+        from the same chunk as a poisoned frame) flush first so the
+        client sees them in order; the sender task never holds a frame
+        un-written across an await, so this cannot double-send."""
         try:
+            while not self.out.empty():
+                self.writer.write(self.out.get_nowait())
             self.writer.write(wire.encode_frame(
                 {"t": "error", "message": message}))
             await self.writer.drain()

@@ -83,6 +83,23 @@ class DedupLedger:
             if client_seq > self._last.get(key, 0):
                 self._last[key] = int(client_seq)
 
+    def record_many(self, items) -> None:
+        """Record a whole ack window's ``(doc, client, client_seq, seq)``
+        tuples under ONE lock acquisition — the batch front door fans a
+        window's acks in one pass and a per-op lock round-trip there costs
+        more than the record itself."""
+        with self._lock:
+            for doc_id, client_id, client_seq, seq in items:
+                key = (doc_id, int(client_id))
+                led = self._led.get(key)
+                if led is None:
+                    led = self._led[key] = collections.OrderedDict()
+                led[int(client_seq)] = int(seq)
+                while len(led) > self.window:
+                    led.popitem(last=False)
+                if client_seq > self._last.get(key, 0):
+                    self._last[key] = int(client_seq)
+
     def lookup(self, doc_id: str, client_id: int,
                client_seq: int) -> Optional[int]:
         with self._lock:
@@ -678,6 +695,21 @@ class ServingEngineBase:
         moment it acks (post-durable-append), arming idempotent dup-acks
         for later resubmits of the same op."""
         self._dedup.record(doc_id, client_id, client_seq, seq)
+
+    def note_acked_planes(self, rows, clients, client_seqs, seqs) -> None:
+        """Vectorized ``note_acked``: one call (and one ledger lock) per
+        ack window. ``seqs <= 0`` entries are nacks — never recorded."""
+        seqs = np.asarray(seqs)
+        ok = seqs > 0
+        if not bool(ok.any()):
+            return
+        rdi = self._row_doc_id
+        self._dedup.record_many(
+            (rdi[r], c, cs, sq) for r, c, cs, sq in zip(
+                np.asarray(rows)[ok].tolist(),
+                np.asarray(clients)[ok].tolist(),
+                np.asarray(client_seqs)[ok].tolist(),
+                seqs[ok].tolist()))
 
     # --------------------------------------------------------------- ingress
 

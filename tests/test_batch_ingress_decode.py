@@ -1,0 +1,318 @@
+"""Byte-split fuzz + oracle parity for the batch ingress decode
+(ISSUE 15): the accumulate-then-drain door must produce the SAME
+(ops, acks, nacks, errors) as the retired per-frame decoder no matter
+where the byte stream is cut — mid-header, mid-payload, mid-crc, across
+drain passes — and the native (libingress.so) and numpy tiers must agree
+bit-for-bit, including on poisoned input."""
+
+import socket
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.server import native_deli, native_ingress
+from fluidframework_tpu.server.columnar_ingress import (
+    ColumnarAlfred, ColumnarClient, _OP_DTYPE, SCAN_BAD_CRC,
+    SCAN_TOO_LARGE, encode_frame, encode_json, encode_op_batch,
+    read_frame, reference_decode_op_frame, split_frames,
+)
+from fluidframework_tpu.server.serving import StringServingEngine
+
+TIERS = [False] + ([True] if native_ingress.available() else [])
+
+
+def _ops(rows, kinds, a0s, a1s, tidxs, cseqs, refs):
+    ops = np.zeros(len(rows), _OP_DTYPE)
+    ops["row"], ops["kind"] = rows, kinds
+    ops["a0"], ops["a1"], ops["tidx"] = a0s, a1s, tidxs
+    ops["cseq"], ops["ref"] = cseqs, refs
+    return ops
+
+
+def _stream():
+    """A representative frame stream: control, plain batch, rich batch,
+    a zero-op frame, and a second control frame."""
+    frames = [
+        encode_json({"t": "join", "docs": ["d0", "d1"]}),
+        encode_op_batch(["hello ", "world"],
+                        _ops([0, 1, 0], [0, 0, 1], [0, 0, 2], [0, 0, 4],
+                             [0, 1, 0], [1, 1, 2], [0, 0, 0])),
+        encode_op_batch(["x"],
+                        _ops([1, 0], [2, 0], [0, 6], [3, 6], [0, 0],
+                             [2, 3], [0, 0]),
+                        props=[{"bold": True}]),
+        encode_op_batch([], _ops([], [], [], [], [], [], [])),
+        encode_json({"t": "bye"}),
+    ]
+    return frames, b"".join(frames)
+
+
+# ------------------------------------------------------- splitter fuzz
+
+@pytest.mark.parametrize("native", TIERS,
+                         ids=["numpy", "native"][:len(TIERS)])
+def test_split_frames_every_cut_offset(native):
+    """Feed the stream cut at EVERY byte offset (two drain calls) — the
+    union of both calls' frames must equal the whole-buffer split, and
+    the torn tail must never produce a frame or consume bytes."""
+    frames, blob = _stream()
+    whole, consumed, status = split_frames(blob, native=native)
+    assert status == 0 and consumed == len(blob)
+    assert len(whole) == len(frames)
+    for cut in range(len(blob) + 1):
+        a, ca, sa = split_frames(blob[:cut], native=native)
+        assert sa == 0
+        # frames reported by the first call must sit on true frame
+        # boundaries and be re-derivable from the whole split
+        assert a == whole[:len(a)]
+        rest = blob[ca:cut] + blob[cut:]
+        b, cb, sb = split_frames(rest, native=native)
+        assert sb == 0 and ca + cb == len(blob)
+        shifted = [(t, off + ca, ln) for t, off, ln in b]
+        assert a + shifted == whole
+
+
+@pytest.mark.parametrize("native", TIERS,
+                         ids=["numpy", "native"][:len(TIERS)])
+def test_split_frames_poisoned(native):
+    frames, blob = _stream()
+    # corrupt one payload byte of frame 2: scan must deliver frames 0-1,
+    # stop AT the bad frame, and exclude it from `consumed`
+    bad = bytearray(blob)
+    f2_off = len(frames[0]) + len(frames[1])
+    bad[f2_off + 5] ^= 0xFF
+    got, consumed, status = split_frames(bytes(bad), native=native)
+    assert status == SCAN_BAD_CRC
+    assert len(got) == 2 and consumed == f2_off
+    # oversized length field: stop with SCAN_TOO_LARGE, same prefix rule
+    big = blob[:f2_off] + struct.pack("<BI", ord("B"), 1 << 30)
+    got, consumed, status = split_frames(big, native=native)
+    assert status == SCAN_TOO_LARGE
+    assert len(got) == 2 and consumed == f2_off
+
+
+@pytest.mark.skipif(len(TIERS) < 2, reason="native ingress unavailable")
+def test_split_frames_tiers_agree():
+    _, blob = _stream()
+    cases = [blob, blob[:17], blob[:5], b"", b"\x00" * 8]
+    bad = bytearray(blob)
+    bad[9] ^= 1
+    cases.append(bytes(bad))
+    for buf in cases:
+        assert split_frames(buf, native=False) == \
+            split_frames(buf, native=True)
+
+
+# --------------------------------------------------- per-frame oracle
+
+def test_reference_decoder_round_trip():
+    texts = ["alpha", "β-utf8 ✓", ""]
+    props = [{"color": "red"}, {"nested": {"a": [1, 2]}}]
+    ops = _ops([3, 7], [0, 2], [1, 2], [0, 9], [1, 1], [10, 11], [5, 6])
+    frame = encode_op_batch(texts, ops, props=props)
+    payload = frame[5:-4]
+    t, p, got = reference_decode_op_frame(payload, rich=True)
+    assert t == texts and p == props
+    assert got.tobytes() == ops.tobytes()
+
+
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda pl: pl[:len(pl) - 7], "record section"),
+    (lambda pl: pl[:2], None),          # truncated table → struct/IndexError
+    (lambda pl: b"\x05" + pl[1:], None),  # table overruns payload
+])
+def test_reference_decoder_rejects(mutate, msg):
+    ops = _ops([0], [0], [0], [0], [0], [1], [0])
+    frame = encode_op_batch(["t"], ops)
+    payload = mutate(frame[5:-4])
+    with pytest.raises((ValueError, IndexError, struct.error)) as ei:
+        reference_decode_op_frame(payload, rich=False)
+    if msg:
+        assert msg in str(ei.value)
+
+
+@pytest.mark.parametrize("rich", [False, True])
+def test_reference_decoder_validation_messages(rich):
+    # tidx beyond the table
+    ops = _ops([0], [0], [0], [0], [7], [1], [0])
+    frame = encode_op_batch(["only"], ops,
+                            props=[{"k": 1}] if rich else None)
+    with pytest.raises(ValueError, match="text-table range"):
+        reference_decode_op_frame(frame[5:-4], rich=rich)
+    # kind beyond what the frame type carries
+    ops = _ops([0], [2 if not rich else 3], [0], [0], [0], [1], [0])
+    frame = encode_op_batch(["t"], ops,
+                            props=[{"k": 1}] if rich else None)
+    with pytest.raises(ValueError, match="op kind out of range"):
+        reference_decode_op_frame(frame[5:-4], rich=rich)
+
+
+# ------------------------------------------------- end-to-end dribble
+
+pytestmark_native = pytest.mark.skipif(
+    not native_deli.available(), reason="native sequencer unavailable")
+
+
+def _mk(decode="auto", window_ms=1.0):
+    eng = StringServingEngine(n_docs=8, capacity=256,
+                              batch_window=10 ** 9, sequencer="native")
+    srv = ColumnarAlfred(eng, window_min_rows=4, window_ms=window_ms,
+                         decode=decode).start_in_thread()
+    return eng, srv
+
+
+def _drive(srv, blob, n_acks, cuts, client_id=None, bases=None):
+    """Send ``blob`` (a post-join op stream) sliced at ``cuts`` with a
+    tiny pause (so drain ticks land mid-stream), then collect ``n_acks``
+    acks. Returns the cut-invariant ack pattern: the sorted set of
+    ``(row, cseq - bases[row], acked?)`` — exact seqs vary with window
+    packing, but WHICH ops ack vs nack cannot — after asserting per-row
+    seq order follows cseq order (per-doc FIFO)."""
+    import time
+    from collections import defaultdict
+    cl = ColumnarClient("127.0.0.1", srv.port)
+    cl.join(["d0", "d1"], client_id=client_id)
+    pos = 0
+    for cut in [*cuts, len(blob)]:
+        if cut > pos:
+            cl.sock.sendall(blob[pos:cut])
+            pos = cut
+            time.sleep(0.004)
+    got = []
+    while len(got) < n_acks:
+        resp = cl.recv_json()
+        assert resp["t"] == "acks", resp
+        for (cseq, seq), row in zip(resp["acks"], resp["rows"]):
+            got.append((row, cseq, seq))
+    cl.close()
+    per_row = defaultdict(list)
+    for r, c, s in got:
+        if s > 0:
+            per_row[r].append((c, s))
+    for r, pairs in per_row.items():
+        pairs.sort()
+        seqs = [s for _, s in pairs]
+        assert seqs == sorted(seqs), f"row {r} acked out of FIFO: {pairs}"
+    bases = bases or {}
+    return sorted((r, c - bases.get(r, 0), s > 0) for r, c, s in got)
+
+
+@pytestmark_native
+@pytest.mark.parametrize("decode", ["numpy"] +
+                         (["native"] if native_ingress.available()
+                          else []))
+def test_dribbled_stream_acks_match_clean_run(decode):
+    """Cut the SAME op stream at every byte offset (one cut per run,
+    dribbled across drain passes): the ack/nack pattern, per-row FIFO
+    order, and ingested-op count must match the cleanly-sent run. Ops
+    are net-zero (insert then remove) so hundreds of runs don't run the
+    docs out of capacity."""
+    eng, srv = _mk(decode=decode)
+    try:
+        # every run resumes the SAME client identity (its seat persists;
+        # a fresh client per cut would exhaust doc capacity) with cseqs
+        # continuing CONTIGUOUSLY per row (the dedup cursor nacks gaps).
+        # cseqs are fixed-width, so every run's blob has identical
+        # length and cut offsets line up across runs.
+        cid = 777
+
+        def mkblob(run):
+            b0, b1_ = 2 * run, 3 * run   # row 0 sends 2 ops/run, row 1: 3
+            fb = encode_op_batch(
+                ["aa", "bb"],
+                _ops([0, 1], [0, 0], [0, 0], [0, 0], [0, 1],
+                     [b0 + 1, b1_ + 1], [0, 0]))
+            fr = encode_op_batch(
+                [], _ops([1], [2], [0], [2], [0], [b1_ + 2], [0]),
+                props=[{"mark": "x"}])
+            f2 = encode_op_batch(
+                [], _ops([0, 1], [1, 1], [0, 0], [2, 2], [0, 0],
+                         [b0 + 2, b1_ + 3], [0, 0]))
+            return fb + fr + f2, {0: b0, 1: b1_}
+
+        n_acks = 5
+        blob, bases = mkblob(0)
+        before = srv.ops_ingested
+        want = _drive(srv, blob, n_acks=n_acks, cuts=[],
+                      client_id=cid, bases=bases)
+        want_ops = srv.ops_ingested - before
+        assert want_ops == n_acks
+        for cut in range(1, len(blob)):
+            blob, bases = mkblob(cut)
+            before = srv.ops_ingested
+            got = _drive(srv, blob, n_acks=n_acks, cuts=[cut],
+                         client_id=cid, bases=bases)
+            assert got == want, f"cut={cut}"
+            assert srv.ops_ingested - before == want_ops, f"cut={cut}"
+    finally:
+        srv.stop()
+
+
+@pytestmark_native
+def test_mid_stream_corruption_keeps_prefix():
+    """Good frames ahead of a CRC-poisoned one in the same drain still
+    SEQUENCE (their ack goes to the now-dead socket, exactly as the
+    per-frame door dropped it — resubmit+dedup recovers it); the client
+    gets the diagnostic, the connection dies, the server keeps
+    serving."""
+    import time
+    eng, srv = _mk()
+    try:
+        good = encode_op_batch(["ok"],
+                               _ops([0], [0], [0], [0], [0], [1], [0]))
+        bad = bytearray(encode_op_batch(
+            ["zz"], _ops([1], [0], [0], [0], [0], [2], [0])))
+        bad[7] ^= 0x55
+        cl = ColumnarClient("127.0.0.1", srv.port)
+        cl.join(["d0", "d1"])
+        cl.sock.sendall(good + bytes(bad))
+        resp = cl.recv_json()
+        assert resp["t"] == "error" and "crc" in resp["message"].lower()
+        assert cl.sock.recv(1) == b""
+        # the good prefix was still decoded and sequenced
+        deadline = time.monotonic() + 2.0
+        while srv.ops_ingested < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.ops_ingested == 1
+        # server survives: a fresh client still gets service
+        cl2 = ColumnarClient("127.0.0.1", srv.port)
+        cl2.join(["d0"])
+        cl2.send_ops(["y"], _ops([0], [0], [0], [0], [0], [1], [0]))
+        assert cl2.recv_json()["t"] == "acks"
+        cl2.close()
+    finally:
+        srv.stop()
+
+
+@pytestmark_native
+def test_oversized_frame_faults_connection():
+    eng, srv = _mk()
+    try:
+        cl = ColumnarClient("127.0.0.1", srv.port)
+        cl.join(["d0"])
+        cl.sock.sendall(struct.pack("<BI", ord("B"), 1 << 30))
+        resp = cl.recv_json()
+        assert resp["t"] == "error" and "too large" in resp["message"]
+        assert cl.sock.recv(1) == b""
+    finally:
+        srv.stop()
+
+
+@pytestmark_native
+def test_numpy_tier_end_to_end():
+    """The always-available fallback must serve the full socket path on
+    its own (no native library consulted)."""
+    eng, srv = _mk(decode="numpy")
+    try:
+        assert srv.drain_stats()["tier"] == "numpy"
+        cl = ColumnarClient("127.0.0.1", srv.port)
+        cl.join(["d0"])
+        cl.send_ops(["hi"], _ops([0], [0], [0], [0], [0], [1], [0]))
+        assert cl.recv_json()["acks"][0][1] > 0
+        st = srv.drain_stats()
+        assert st["passes"] >= 1 and st["drained_bytes"] > 0
+        cl.close()
+    finally:
+        srv.stop()

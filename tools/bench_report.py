@@ -127,6 +127,10 @@ INGRESS_KEYS = (
     "columnar_ingress_ops_per_sec",
     "columnar_ingress_ops_per_sec_median", "columnar_ingress_trials",
     "columnar_ingress_windows",
+    # ISSUE 15 batch-decode evidence: drain-pass decode p50, drained
+    # bytes per pass, and the decode tier that served (native/numpy)
+    "ingress_decode_p50_ms", "ingress_drained_bytes_per_pass",
+    "ingress_drain_passes", "ingress_decode_tier",
 )
 TREE_KEYS = (
     "tree_serving_ops_per_sec", "tree_serving_ops_per_sec_median",
