@@ -1442,6 +1442,49 @@ def run():
     except Exception as e:   # noqa: BLE001 — the record must still emit
         reconnect_storm = {"error": repr(e), "invariant_violations": -1}
 
+    # -------------------------------------------------- overload storm
+    # the admission plane under 2x-capacity load (ISSUE 16): the
+    # multi-tenant simulator's quick profile — one abusive tenant at 5x
+    # budget, AIMD policy live — reported as goodput/shed/latency, and
+    # the two correctness counts the perf sentinel hard-gates on:
+    # invariant_violations (exactly-once/order audits) and silent_drops
+    _phase("overload_storm")
+    try:
+        import importlib.util as _ilu
+        _spec = _ilu.spec_from_file_location(
+            "tenant_sim", _os.path.join(
+                _os.path.dirname(_os.path.abspath(__file__)),
+                "tools", "tenant_sim.py"))
+        _tsim = _ilu.module_from_spec(_spec)
+        # registered BEFORE exec: its dataclasses resolve string
+        # annotations through sys.modules[cls.__module__]
+        sys.modules["tenant_sim"] = _tsim
+        _spec.loader.exec_module(_tsim)
+        # lenient latency/goodput floors (shared bench boxes vary);
+        # the sentinel gates only the correctness counts
+        _rep = _tsim.run_sim(seed=123, duration_s=1.2, slo_ms=1000.0,
+                             goodput_min=0.3, quick=True)
+        overload_storm = {
+            "goodput_ratio": _rep["goodput_ratio"],
+            "admitted_ack_p99_ms": _rep["admitted_ack_p99_ms"],
+            "shed_ratio": _rep["shed_ratio"],
+            "shed_total": _rep["shed_total"],
+            "throttled_frames": _rep["throttled_frames"],
+            "throttle_resubmits": _rep["throttle_resubmits"],
+            "abusive_throttled": _rep["abusive_throttled"],
+            "abusive_shed": _rep["abusive_shed"],
+            "ops_offered": _rep["ops_offered"],
+            "ops_acked": _rep["ops_acked"],
+            "policy_breach_ticks": _rep["policy"]["breach_ticks"],
+            "policy_min_scale": _rep["policy"]["min_scale"],
+            "silent_drops": _rep["silent_drops"],
+            "invariant_violations": _rep["violations"],
+            "gate_failures": _rep["gate_failures"],
+        }
+    except Exception as e:   # noqa: BLE001 — the record must still emit
+        overload_storm = {"error": repr(e), "invariant_violations": -1,
+                          "silent_drops": -1}
+
     # ------------------------------------------------------- durability
     # the recovery ladder under the clock (ISSUE 10): summary load + tail
     # replay timed at ladder depth 0 (newest generation verifies) and
@@ -1644,6 +1687,10 @@ def run():
         # throughput/latency plus the invariant-violation count the
         # perf sentinel gates on
         "reconnect_storm": reconnect_storm,
+        # overload protection under 2x-capacity multi-tenant load
+        # (ISSUE 16): goodput/shed split plus the correctness counts
+        # (invariant_violations, silent_drops) the sentinel gates on
+        "overload_storm": overload_storm,
         # durable-layer integrity under the clock (ISSUE 10): recovery
         # ladder p50 at depth 0/1 + the scrub's chain-break count the
         # perf sentinel hard-gates on

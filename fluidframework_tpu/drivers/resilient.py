@@ -29,6 +29,14 @@ that were already sequenced. Two wrappers here:
 Both are deterministic under injected ``random.Random`` (reconnect
 schedules replay exactly in a seeded chaos soak) and track reconnect
 latencies / resubmit counts for the bench's reconnect-storm phase.
+
+Both also honor the admission plane's ``throttled`` frames
+(``server.admission``): a shed op's clientSeq is NOT burned (it was
+refused before the sequencer saw it), so the op parks locally and is
+resubmitted with the SAME number, in cseq order, after a jittered
+``retry_after_ms`` — never a blind instant resubmit, never a silent
+drop. Ops submitted while a throttle episode is pending park too and
+ride the same ordered resend.
 """
 
 from __future__ import annotations
@@ -65,14 +73,35 @@ class ResilientConnection:
     def __init__(self, host: str, port: int, doc_id: str,
                  rng=None, attempts: int = 8,
                  base_delay: float = 0.02,
-                 on_op: Optional[Callable] = None):
+                 on_op: Optional[Callable] = None,
+                 tenant: Optional[str] = None,
+                 dial_timeout: float = 10.0,
+                 recv_timeout: Optional[float] = None,
+                 on_ack: Optional[Callable] = None):
         self.host = host
         self.port = port
         self.doc_id = doc_id
         self.attempts = attempts
+        #: tenant identity carried on connect/resync so server-side
+        #: admission budgets apply (None = per-client default tenant)
+        self.tenant = tenant
+        #: connect()/dial timeout; also bounds each handshake recv
+        self.dial_timeout = dial_timeout
+        #: steady-state recv timeout. None = block forever (an idle but
+        #: healthy stream is NOT an error); a value turns prolonged
+        #: stream silence into a reconnect — opt-in, since any quiet
+        #: period longer than this looks like a dead peer
+        self.recv_timeout = recv_timeout
         self._backoff = Backoff(base=base_delay, cap=1.0, rng=rng)
         self._lock = threading.RLock()
         self._acked_cv = threading.Condition(self._lock)
+        #: serializes op WRITES to the socket: a resend wave (retry
+        #: timer / reconnect, on their own threads) must hit the wire
+        #: as one ordered run — a concurrent submit interleaving
+        #: mid-wave would reorder clientSeqs and gap-nack. Always
+        #: acquired while still holding ``_lock`` (released after the
+        #: send), so wire order matches registration order.
+        self._send_lock = threading.Lock()
         self._uid = itertools.count(1)
         #: cseq → (uid, op fields) — in submission order (OrderedDict so
         #: renumbering preserves it)
@@ -86,12 +115,26 @@ class ResilientConnection:
         self.reconnects = 0
         self.resubmits = 0
         self.dup_acked = 0
+        self.throttled = 0           # throttled frames received
+        self.throttle_resubmits = 0  # ops re-sent after a retry_after
+        #: cseqs currently parked behind a throttle (resent, in order,
+        #: by the retry timer — never renumbered, never silently lost)
+        self._throttled: set = set()
+        #: uids that were EVER throttled — their ack latency includes
+        #: the deliberate backoff, so latency SLO accounting (the tenant
+        #: sim's admitted-ack p99) excludes them
+        self.throttled_uids: set = set()
+        self._retry_timer: Optional[threading.Timer] = None
+        self._retry_at = 0.0
         self.reconnect_latencies: List[float] = []
         self._op_listeners: List[Callable] = []
+        self._ack_listeners: List[Callable] = []
         self._closed = False
         self._sock: Optional[socket.socket] = None
         if on_op is not None:
             self._op_listeners.append(on_op)
+        if on_ack is not None:
+            self._ack_listeners.append(on_ack)
         self._connect_first()
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True)
@@ -100,8 +143,17 @@ class ResilientConnection:
     # ------------------------------------------------------------- connect
 
     def _dial(self) -> socket.socket:
+        # the dial timeout also bounds handshake recvs (create_connection
+        # leaves it on the socket); _settle() switches to the
+        # steady-state recv_timeout once the stream is live
         return socket.create_connection((self.host, self.port),
-                                        timeout=10.0)
+                                        timeout=self.dial_timeout)
+
+    def _settle(self, sock: socket.socket) -> None:
+        try:
+            sock.settimeout(self.recv_timeout)
+        except OSError:
+            pass
 
     def _connect_first(self) -> None:
         last: Optional[Exception] = None
@@ -109,14 +161,22 @@ class ResilientConnection:
         for i in range(self.attempts):
             try:
                 sock = self._dial()
-                wire.send_frame(sock, {"t": "connect",
-                                       "doc": self.doc_id,
-                                       "resilient": True})
+                hello_req = {"t": "connect", "doc": self.doc_id,
+                             "resilient": True}
+                if self.tenant is not None:
+                    hello_req["tenant"] = self.tenant
+                wire.send_frame(sock, hello_req)
                 hello = wire.recv_frame(sock)
                 if hello.get("t") != "connected":
                     raise wire.WireError(f"bad hello: {hello}")
                 self.client_id = int(hello["client_id"])
                 self.epoch = hello.get("epoch", 0)
+                # seed the ref_seq cursor from the hello's current doc
+                # seq: the first submit must reference live state, not
+                # seq 0 (below the MSN floor on a long-lived doc)
+                self.last_seen_seq = max(self.last_seen_seq,
+                                         int(hello.get("seq", 0)))
+                self._settle(sock)
                 self._sock = sock
                 return
             except OSError as e:        # noqa: PERF203 — retry loop
@@ -139,10 +199,13 @@ class ResilientConnection:
             time.sleep(self._backoff.next_delay())
             try:
                 sock = self._dial()
-                wire.send_frame(sock, {
+                resync_req = {
                     "t": "resync", "doc": self.doc_id,
                     "client_id": self.client_id,
-                    "from_seq": self.last_seen_seq})
+                    "from_seq": self.last_seen_seq}
+                if self.tenant is not None:
+                    resync_req["tenant"] = self.tenant
+                wire.send_frame(sock, resync_req)
                 # the stream attaches server-side BEFORE the catch-up
                 # fetch (no loss window, duplicate delivery possible):
                 # live op frames may arrive ahead of the resynced frame
@@ -157,11 +220,15 @@ class ResilientConnection:
             # catch-up tail first: every still-durable in-flight op acks
             # here (broadcast is seq-ordered, the tail is complete up to
             # now) — what remains pending is exactly the never-durable set
+            self._settle(sock)
             for m in frame.get("msgs", []):
                 self._dispatch({"t": "op", "msg": m})
             self.epoch = frame.get("epoch", self.epoch)
             lcs = int(frame.get("last_client_seq", 0))
             with self._lock:
+                # a full resubmit supersedes any throttle episode (the
+                # renumbered resend below covers every pending op)
+                self._throttled.clear()
                 # renumber the survivors contiguously past the server's
                 # cursor: burned clientSeqs (sequenced-but-never-durable)
                 # are skipped, submission order is preserved
@@ -175,12 +242,16 @@ class ResilientConnection:
                     self._pending[self._client_seq] = (uid, op)
                     resend.append(op)
                 self._sock = sock
-            for op in resend:
-                self.resubmits += 1
-                try:
-                    wire.send_frame(sock, op)
-                except OSError:
-                    break   # socket died again: next reconnect resubmits
+                self._send_lock.acquire()
+            try:
+                for op in resend:
+                    self.resubmits += 1
+                    try:
+                        wire.send_frame(sock, op)
+                    except OSError:
+                        break   # died again: next reconnect resubmits
+            finally:
+                self._send_lock.release()
             self.reconnects += 1
             REGISTRY.inc("session_reconnects_total")
             self.reconnect_latencies.append(time.perf_counter() - t0)
@@ -228,6 +299,20 @@ class ResilientConnection:
             with self._acked_cv:
                 self.dup_acked += 1
                 self._ack(int(frame["client_seq"]), int(frame["seq"]))
+        elif t == "throttled":
+            # admission shed: the op never reached the sequencer, its
+            # cseq is NOT burned — park it and resubmit the SAME number
+            # after a jittered retry_after, in cseq order (blind instant
+            # resubmit would just be shed again)
+            with self._acked_cv:
+                self.throttled += 1
+                REGISTRY.inc("client_throttled_total")
+                cs = frame.get("client_seq")
+                if cs in self._pending:
+                    self._throttled.add(cs)
+                    self.throttled_uids.add(self._pending[cs][0])
+                self._schedule_retry(
+                    float(frame.get("retry_after_ms", 50.0)))
         elif t == "nack":
             reason = frame.get("reason")
             seq = frame.get("seq", -1)
@@ -247,6 +332,62 @@ class ResilientConnection:
             uid, _op = ent
             self.op_acks[uid] = seq
             self._acked_cv.notify_all()
+            for fn in self._ack_listeners:
+                fn(uid, seq)
+
+    # ------------------------------------------------------------ throttling
+
+    def _schedule_retry(self, retry_ms: float) -> None:
+        """Arm ONE timer per throttle episode (lock held by caller),
+        jittered so a fleet of throttled clients does not resubmit in
+        lockstep. Retry hints GROW as the server sheds more of the run
+        (they cover the whole parked backlog) — a later, larger hint
+        extends the armed timer instead of being dropped, so the resend
+        fires once, when the budget can actually take the run."""
+        if self._closed:
+            return
+        delay = (max(1.0, retry_ms) / 1000.0) \
+            * self._backoff.rng.uniform(1.0, 1.5)
+        fire_at = time.monotonic() + delay
+        if self._retry_timer is not None:
+            if fire_at <= self._retry_at:
+                return
+            self._retry_timer.cancel()
+        self._retry_at = fire_at
+        t: Optional[threading.Timer] = None
+        t = threading.Timer(delay,
+                            lambda: self._resubmit_throttled(t))
+        t.daemon = True
+        self._retry_timer = t
+        t.start()
+
+    def _resubmit_throttled(self, timer) -> None:
+        with self._lock:
+            if self._retry_timer is not timer:
+                return   # superseded by a later re-arm (or shutdown)
+            self._retry_timer = None
+            if self._closed:
+                return
+            cseqs = sorted(cs for cs in self._throttled
+                           if cs in self._pending)
+            self._throttled.clear()
+            ops = [self._pending[cs][1] for cs in cseqs]
+            sock = self._sock
+            self._send_lock.acquire()
+        try:
+            for op in ops:
+                self.throttle_resubmits += 1
+                try:
+                    wire.send_frame(sock, op)
+                except OSError:
+                    break   # reader notices the dead socket and resyncs
+        finally:
+            self._send_lock.release()
+
+    def on_ack(self, fn: Callable) -> None:
+        """Register an ack listener ``fn(uid, seq)`` (called with the
+        connection lock held — keep it cheap)."""
+        self._ack_listeners.append(fn)
 
     def on_op(self, fn: Callable) -> None:
         self._op_listeners.append(fn)
@@ -255,9 +396,12 @@ class ResilientConnection:
 
     def submit(self, contents: Any, type: MessageType = MessageType.OP,
                ref_seq: Optional[int] = None,
-               address: Optional[str] = None) -> int:
+               address: Optional[str] = None,
+               deadline_ms: Optional[float] = None) -> int:
         """Submit one op; returns its uid (stable across renumbering —
-        look the ack up in ``op_acks[uid]``)."""
+        look the ack up in ``op_acks[uid]``). ``deadline_ms`` rides the
+        frame as the op's ingress deadline budget (admission sheds work
+        it estimates would sequence too late)."""
         if self._closed:
             raise ConnectionError("submit on closed connection")
         with self._lock:
@@ -268,14 +412,26 @@ class ResilientConnection:
                   "ref_seq": self.last_seen_seq if ref_seq is None
                   else ref_seq,
                   "address": address}
+            if deadline_ms is not None:
+                op["deadline_ms"] = deadline_ms
             # pending BEFORE the send: a socket death mid-write still
             # leaves the op tracked for resubmit
             self._pending[self._client_seq] = (uid, op)
+            if self._retry_timer is not None:
+                # throttle episode in flight: sending now would only be
+                # shed behind the fence — park locally, the retry timer
+                # resends the whole run in cseq order
+                self._throttled.add(self._client_seq)
+                self.throttled_uids.add(uid)
+                return uid
             sock = self._sock
+            self._send_lock.acquire()
         try:
             wire.send_frame(sock, op)
         except OSError:
             pass    # reader notices the dead socket and resyncs
+        finally:
+            self._send_lock.release()
         return uid
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
@@ -310,6 +466,9 @@ class ResilientConnection:
 
     def close(self) -> None:
         self._closed = True
+        timer = self._retry_timer
+        if timer is not None:
+            timer.cancel()
         sock = self._sock
         try:
             wire.send_frame(sock, {"t": "disconnect"})
@@ -335,14 +494,31 @@ class ResilientColumnarClient:
 
     def __init__(self, host: str, port: int, docs: List[str],
                  rng=None, attempts: int = 8,
-                 base_delay: float = 0.02):
+                 base_delay: float = 0.02,
+                 tenant: Optional[str] = None,
+                 dial_timeout: float = 10.0,
+                 recv_timeout: Optional[float] = None,
+                 on_ack: Optional[Callable] = None):
         self.host = host
         self.port = port
         self.docs = list(docs)
         self.attempts = attempts
+        self.tenant = tenant
+        self.dial_timeout = dial_timeout
+        #: None = block forever on a quiet stream; a value turns
+        #: prolonged silence into a rejoin (opt-in, see
+        #: ResilientConnection.recv_timeout)
+        self.recv_timeout = recv_timeout
         self._backoff = Backoff(base=base_delay, cap=1.0, rng=rng)
         self._lock = threading.RLock()
         self._acked_cv = threading.Condition(self._lock)
+        #: serializes op WRITES to the socket: a resend wave (retry
+        #: timer / reconnect, on their own threads) must hit the wire
+        #: as one ordered run — a concurrent submit interleaving
+        #: mid-wave would reorder clientSeqs and gap-nack. Always
+        #: acquired while still holding ``_lock`` (released after the
+        #: send), so wire order matches registration order.
+        self._send_lock = threading.Lock()
         self._closed = False
         self.client_id: Optional[int] = None
         self.rows: Dict[str, int] = {}
@@ -358,6 +534,20 @@ class ResilientColumnarClient:
         self.reconnects = 0
         self.resubmits = 0
         self.dup_acked = 0
+        self.throttled = 0
+        self.throttle_resubmits = 0
+        #: doc → cseqs parked behind a throttle (resent in cseq order
+        #: by the retry timer)
+        self._throttled: Dict[str, set] = {d: set() for d in self.docs}
+        #: doc → cseqs EVER throttled (latency accounting excludes them:
+        #: their ack time includes the deliberate backoff)
+        self.throttled_cseqs: Dict[str, set] = {d: set()
+                                                for d in self.docs}
+        self._retry_timer: Optional[threading.Timer] = None
+        self._retry_at = 0.0
+        self._ack_listeners: List[Callable] = []
+        if on_ack is not None:
+            self._ack_listeners.append(on_ack)
         self.reconnect_latencies: List[float] = []
         self._sock = self._join(first=True)
         self._reader = threading.Thread(target=self._read_loop,
@@ -368,8 +558,11 @@ class ResilientColumnarClient:
 
     def _join(self, first: bool = False) -> socket.socket:
         sock = colwire.connect_with_backoff(
-            self.host, self.port, attempts=self.attempts)
+            self.host, self.port, attempts=self.attempts,
+            timeout=self.dial_timeout)
         req = {"t": "join", "docs": self.docs}
+        if self.tenant is not None:
+            req["tenant"] = self.tenant
         if not first:
             req["client_id"] = self.client_id
         sock.sendall(colwire.encode_json(req))
@@ -382,6 +575,10 @@ class ResilientColumnarClient:
         self.row_doc = {r: d for d, r in self.rows.items()}
         self.lcs = dict(resp.get("lcs", {}))
         self.epoch = resp.get("epoch", 0)
+        try:
+            sock.settimeout(self.recv_timeout)
+        except OSError:
+            pass
         return sock
 
     def _reconnect(self) -> None:
@@ -399,16 +596,23 @@ class ResilientColumnarClient:
                 continue
             with self._lock:
                 self._sock = sock
+                # the full resubmit below supersedes any throttle episode
+                for shed in self._throttled.values():
+                    shed.clear()
                 resend = [(d, list(pend.items()))
                           for d, pend in self._pending.items() if pend]
+                self._send_lock.acquire()
             # resubmit per doc in cseq order: durable ones dup-ack with
             # their original seq, the rest sequence fresh — per-doc order
             # is preserved because each doc's resend list is ordered
-            for doc, ops in resend:
-                for cs, (kind, a0, a1, payload, ref) in ops:
-                    self.resubmits += 1
-                    self._send_one(sock, doc, cs, kind, a0, a1,
-                                   payload, ref)
+            try:
+                for doc, ops in resend:
+                    for cs, (kind, a0, a1, payload, ref) in ops:
+                        self.resubmits += 1
+                        self._send_one(sock, doc, cs, kind, a0, a1,
+                                       payload, ref)
+            finally:
+                self._send_lock.release()
             self.reconnects += 1
             REGISTRY.inc("session_reconnects_total")
             self.reconnect_latencies.append(time.perf_counter() - t0)
@@ -450,10 +654,80 @@ class ResilientColumnarClient:
                                     and cs in self.acks[doc]:
                                 continue   # re-delivered ack
                             self.acks[doc][cs] = sq
+                            for fn in self._ack_listeners:
+                                fn(doc, cs, sq)
                         else:
                             self._pending[doc].pop(cs, None)
                             self.nacks.append((doc, cs, sq))
                     self._acked_cv.notify_all()
+            elif resp.get("t") == "throttled":
+                # admission shed an op suffix: cseqs are NOT burned —
+                # park them, resubmit the SAME numbers in order after
+                # the jittered retry_after
+                cseqs = resp.get("cseqs", [])
+                with self._acked_cv:
+                    for row, cs in zip(resp.get("rows", []), cseqs):
+                        doc = self.row_doc.get(row)
+                        if doc is not None \
+                                and cs in self._pending[doc]:
+                            self._throttled[doc].add(cs)
+                            self.throttled_cseqs[doc].add(cs)
+                    self.throttled += len(cseqs)
+                    REGISTRY.inc("client_throttled_total", len(cseqs))
+                    self._schedule_retry(
+                        float(resp.get("retry_after_ms", 50.0)))
+
+    # ------------------------------------------------------------ throttling
+
+    def _schedule_retry(self, retry_ms: float) -> None:
+        """One timer per throttle episode (lock held by caller); a
+        later, larger hint extends the armed timer (hints grow with the
+        parked backlog — see ResilientConnection._schedule_retry)."""
+        if self._closed:
+            return
+        delay = (max(1.0, retry_ms) / 1000.0) \
+            * self._backoff.rng.uniform(1.0, 1.5)
+        fire_at = time.monotonic() + delay
+        if self._retry_timer is not None:
+            if fire_at <= self._retry_at:
+                return
+            self._retry_timer.cancel()
+        self._retry_at = fire_at
+        t: Optional[threading.Timer] = None
+        t = threading.Timer(delay,
+                            lambda: self._resubmit_throttled(t))
+        t.daemon = True
+        self._retry_timer = t
+        t.start()
+
+    def _resubmit_throttled(self, timer) -> None:
+        with self._lock:
+            if self._retry_timer is not timer:
+                return   # superseded by a later re-arm (or shutdown)
+            self._retry_timer = None
+            if self._closed:
+                return
+            resend = []
+            for doc, shed in self._throttled.items():
+                cseqs = sorted(cs for cs in shed
+                               if cs in self._pending[doc])
+                shed.clear()
+                resend.extend((doc, cs, self._pending[doc][cs])
+                              for cs in cseqs)
+            sock = self._sock
+            self._send_lock.acquire()
+        try:
+            for doc, cs, (kind, a0, a1, payload, ref) in resend:
+                self.throttle_resubmits += 1
+                self._send_one(sock, doc, cs, kind, a0, a1, payload,
+                               ref)
+        finally:
+            self._send_lock.release()
+
+    def on_ack(self, fn: Callable) -> None:
+        """Register an ack listener ``fn(doc, cseq, seq)`` (called with
+        the client lock held — keep it cheap)."""
+        self._ack_listeners.append(fn)
 
     # -------------------------------------------------------------- submit
 
@@ -485,8 +759,18 @@ class ResilientColumnarClient:
             self._cseq[doc] += 1
             cs = self._cseq[doc]
             self._pending[doc][cs] = (kind, a0, a1, payload, ref)
+            if self._retry_timer is not None:
+                # throttle episode in flight: park locally, the retry
+                # timer resends the whole run in cseq order
+                self._throttled[doc].add(cs)
+                self.throttled_cseqs[doc].add(cs)
+                return cs
             sock = self._sock
-        self._send_one(sock, doc, cs, kind, a0, a1, payload, ref)
+            self._send_lock.acquire()
+        try:
+            self._send_one(sock, doc, cs, kind, a0, a1, payload, ref)
+        finally:
+            self._send_lock.release()
         return cs
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
@@ -517,6 +801,9 @@ class ResilientColumnarClient:
 
     def close(self) -> None:
         self._closed = True
+        timer = self._retry_timer
+        if timer is not None:
+            timer.cancel()
         sock = self._sock
         try:
             sock.sendall(colwire.encode_json({"t": "bye"}))

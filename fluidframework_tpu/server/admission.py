@@ -1,0 +1,423 @@
+"""Overload protection: closed-loop admission control for both doors.
+
+Reference counterpart: Routerlicious' per-tenant throttling in front of
+Alfred (SURVEY.md §1 — the reference service rate-limits ops per tenant
+before they reach the Kafka→Deli pipeline and answers over-budget
+clients with a retryAfter). Here the same role sits in the drain pass of
+BOTH front doors (``server.ingress``, ``server.columnar_ingress``):
+every decoded op batch is offered to one :class:`AdmissionController`
+*before* it reaches the sequencer / ``PipelinedIngestExecutor``, and
+whatever is not admitted is answered with an explicit ``throttled``
+frame carrying ``retry_after_ms`` — shed work is never silently dropped
+and never burns a clientSeq (it is refused before the sequencer ever
+sees the number, so the client resubmits the SAME cseq after backoff).
+
+Three mechanisms, composable and individually optional:
+
+- **per-tenant token buckets** — each tenant declares a budget
+  (ops/sec + burst); a batch consumes tokens for its admitted PREFIX
+  only. Prefix (suffix-shed) semantics matter: the sequencer nacks
+  clientSeq gaps, so once op ``k`` of a batch is shed everything after
+  it must shed too — the doors enforce the same rule across batches
+  with a shed fence. Optional per-doc buckets bound any single
+  document's share the same way.
+- **concurrency limit + deadline shedding** — a batch that would land
+  on a backlog past ``max_inflight_ops`` is shed outright, and when a
+  deadline budget is configured (or the op carries one), a batch whose
+  *estimated* sequencing delay (backlog ÷ EWMA service rate, fed by
+  :meth:`note_served`) already exceeds it is shed at admission instead
+  of wasted in the engine.
+- **pressure shedding** — a probabilistic shed gate plus a global
+  budget *scale* multiplier, both driven by :class:`ControlPolicy`:
+  an AIMD loop over the existing ``SLOEngine`` fast/slow burn-rate
+  windows that halves budgets / steps shed probability up while an
+  objective is burning and additively recovers when it stops.
+
+Every decision is counted (``admission_*`` — docs/OBSERVABILITY.md) so
+healthz and the tenant simulator can see who was shed, why, and how the
+control loop moved.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Hashable, Optional
+
+from ..utils.telemetry import REGISTRY
+
+#: floor on any retry hint — a 0ms hint would have clients hammering
+_MIN_RETRY_MS = 5.0
+#: ceiling on any retry hint — bounded client-side pause per episode
+_MAX_RETRY_MS = 2000.0
+
+
+class TokenBucket:
+    """Classic token bucket with prefix-grant semantics: :meth:`grant`
+    admits the largest prefix of ``n`` requested ops the current tokens
+    cover (never a mid-batch subset — the doors shed suffixes only).
+    ``scale`` multiplies the refill rate AND the burst ceiling, the
+    knob :class:`ControlPolicy` turns per tenant without rebuilding
+    buckets."""
+
+    __slots__ = ("rate", "burst", "tokens", "_t")
+
+    def __init__(self, rate: float, burst: Optional[float] = None):
+        if rate <= 0:
+            raise ValueError(f"rate must be positive, got {rate}")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None else float(rate)
+        self.tokens = self.burst
+        self._t: Optional[float] = None
+
+    def _refill(self, now: float, scale: float) -> None:
+        if self._t is not None and now > self._t:
+            self.tokens = min(self.burst * scale,
+                              self.tokens + self.rate * scale *
+                              (now - self._t))
+        self._t = now
+
+    def grant(self, n: int, now: float, scale: float = 1.0) -> int:
+        """Admit the largest prefix of ``n`` ops covered by the current
+        tokens; consumes exactly what it grants."""
+        self._refill(now, scale)
+        k = min(n, int(self.tokens))
+        if k > 0:
+            self.tokens -= k
+        return k
+
+    def retry_after_ms(self, n: int, now: float,
+                       scale: float = 1.0) -> float:
+        """Milliseconds until ``n`` tokens will have accumulated —
+        pure query, consumes nothing."""
+        self._refill(now, scale)
+        deficit = n - self.tokens
+        if deficit <= 0:
+            return _MIN_RETRY_MS
+        return min(_MAX_RETRY_MS, max(
+            _MIN_RETRY_MS, deficit / (self.rate * scale) * 1000.0))
+
+
+@dataclass
+class Admission:
+    """One :meth:`AdmissionController.admit` verdict: the admitted
+    PREFIX length, the retry hint for the shed suffix, and why."""
+
+    admitted: int
+    retry_after_ms: float = 0.0
+    reason: str = "ok"       # ok | budget | doc_budget | deadline |
+    #                          inflight | pressure
+
+
+class AdmissionController:
+    """Per-tenant/per-doc token-bucket + concurrency-limit admission.
+
+    ``tenants``            {name: ops_per_sec} declared budgets; a
+                           client bound to an unknown/absent tenant is
+                           governed by ``default_rate`` (None = no
+                           budget, admission limited only by the other
+                           gates).
+    ``default_rate``       ops/sec bucket auto-created per tenant on
+                           first sight when set.
+    ``max_inflight_ops``   shed a batch whose backlog-at-admission
+                           exceeds this (0 = unlimited).
+    ``deadline_ms``        default ingress deadline budget per op; a
+                           batch is shed when the EWMA-estimated
+                           sequencing delay already exceeds it (0 =
+                           disabled; ops may carry their own).
+    ``rng``                seeded source for the probabilistic shed
+                           gate (deterministic sims).
+
+    Thread-safe: each door's event loop and the policy ticker share one
+    controller under a single lock.
+    """
+
+    def __init__(self, tenants: Optional[Dict[str, float]] = None,
+                 default_rate: Optional[float] = None,
+                 burst_factor: float = 1.0,
+                 max_inflight_ops: int = 0,
+                 deadline_ms: float = 0.0,
+                 rng: Optional[random.Random] = None,
+                 clock=time.monotonic,
+                 registry=None):
+        self._lock = threading.Lock()
+        self.clock = clock
+        self.default_rate = default_rate
+        self.burst_factor = burst_factor
+        self.max_inflight_ops = max_inflight_ops
+        self.deadline_ms = deadline_ms
+        self.rng = rng or random
+        self.registry = registry if registry is not None else REGISTRY
+        self._tenant_bucket: Dict[str, TokenBucket] = {}
+        self._doc_bucket: Dict[Hashable, TokenBucket] = {}
+        self._tenant_of: Dict[Any, str] = {}
+        #: policy knobs (ControlPolicy writes, admit reads)
+        self.scale = 1.0
+        self.shed_probability = 0.0
+        #: EWMA served ops/sec (deadline estimation); None until fed
+        self._service_rate: Optional[float] = None
+        self._served_t: Optional[float] = None
+        self.admitted_total = 0
+        self.shed_total = 0
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}
+        for name, rate in (tenants or {}).items():
+            self.register_tenant(name, rate)
+
+    # ---------------------------------------------------------- registration
+
+    def register_tenant(self, name: str, rate: float,
+                        burst: Optional[float] = None) -> None:
+        """Declare (or re-declare) a tenant's ops/sec budget."""
+        with self._lock:
+            self._tenant_bucket[name] = TokenBucket(
+                rate, burst if burst is not None
+                else rate * self.burst_factor)
+            self._tenant_stats.setdefault(
+                name, {"admitted": 0, "shed": 0})
+
+    def set_doc_rate(self, doc: Hashable, rate: float,
+                     burst: Optional[float] = None) -> None:
+        """Bound one document's share with its own bucket."""
+        with self._lock:
+            self._doc_bucket[doc] = TokenBucket(
+                rate, burst if burst is not None
+                else rate * self.burst_factor)
+
+    def bind(self, client_id: Any, tenant: Optional[str] = None) -> str:
+        """Bind a client identity to a tenant (join/connect time). A
+        ``None`` tenant keeps any existing binding, else falls back to
+        a per-client default tenant name."""
+        with self._lock:
+            if tenant is None:
+                tenant = self._tenant_of.get(client_id,
+                                             f"client-{client_id}")
+            self._tenant_of[client_id] = tenant
+            if tenant not in self._tenant_bucket \
+                    and self.default_rate is not None:
+                self._tenant_bucket[tenant] = TokenBucket(
+                    self.default_rate,
+                    self.default_rate * self.burst_factor)
+            self._tenant_stats.setdefault(
+                tenant, {"admitted": 0, "shed": 0})
+            return tenant
+
+    def tenant_of(self, client_id: Any) -> str:
+        with self._lock:
+            return self._tenant_of.get(client_id, f"client-{client_id}")
+
+    # --------------------------------------------------------------- control
+
+    def set_pressure(self, scale: Optional[float] = None,
+                     shed_probability: Optional[float] = None) -> None:
+        """Policy knobs: global budget multiplier + probabilistic shed
+        gate. Gauges track both so healthz shows the loop moving."""
+        with self._lock:
+            if scale is not None:
+                self.scale = max(0.0, min(1.0, scale))
+            if shed_probability is not None:
+                self.shed_probability = max(0.0, min(1.0,
+                                                     shed_probability))
+            self.registry.set_gauge("admission_budget_scale", self.scale)
+            self.registry.set_gauge("admission_shed_probability",
+                                    self.shed_probability)
+
+    def note_served(self, n: int, now: Optional[float] = None) -> None:
+        """Feed the EWMA service-rate estimator: ``n`` ops finished
+        sequencing (ack fan-out time). Powers deadline shedding."""
+        if n <= 0:
+            return
+        now = self.clock() if now is None else now
+        with self._lock:
+            if self._served_t is not None:
+                dt = now - self._served_t
+                if dt > 1e-6:
+                    inst = n / dt
+                    self._service_rate = inst if self._service_rate \
+                        is None else (0.8 * self._service_rate
+                                      + 0.2 * inst)
+            self._served_t = now
+
+    def estimated_delay_ms(self, backlog: int) -> float:
+        """Expected sequencing delay for an op landing behind
+        ``backlog`` queued ops, from the EWMA service rate. 0 until
+        the estimator has been fed (absence of evidence never sheds)."""
+        rate = self._service_rate
+        if not rate or backlog <= 0:
+            return 0.0
+        return backlog / rate * 1000.0
+
+    # -------------------------------------------------------------- admission
+
+    def admit(self, client_id: Any, doc: Hashable, n: int,
+              backlog: int = 0, now: Optional[float] = None,
+              deadline_ms: Optional[float] = None) -> Admission:
+        """Offer a batch of ``n`` ops from ``client_id`` on ``doc``.
+        Returns the admitted prefix length plus a retry hint for the
+        shed suffix. Order of gates: deadline (the work is already
+        late), concurrency (the pipeline is already full), pressure
+        (the control loop said brake), then the token buckets."""
+        if n <= 0:
+            return Admission(0, _MIN_RETRY_MS, "ok")
+        now = self.clock() if now is None else now
+        with self._lock:
+            tenant = self._tenant_of.get(client_id,
+                                         f"client-{client_id}")
+            budget = deadline_ms if deadline_ms is not None \
+                else self.deadline_ms
+            if budget and self._estimate_locked(backlog) > budget:
+                return self._shed_locked(tenant, n, "deadline",
+                                         self._retry_locked(tenant, doc,
+                                                            n, now))
+            if self.max_inflight_ops and backlog > self.max_inflight_ops:
+                return self._shed_locked(
+                    tenant, n, "inflight",
+                    self._retry_locked(tenant, doc, n, now))
+            if self.shed_probability > 0.0 \
+                    and self.rng.random() < self.shed_probability:
+                return self._shed_locked(
+                    tenant, n, "pressure",
+                    self._retry_locked(tenant, doc, n, now))
+            k = n
+            reason = "ok"
+            tb = self._tenant_bucket.get(tenant)
+            if tb is not None:
+                k = tb.grant(n, now, self.scale)
+                if k < n:
+                    reason = "budget"
+            db = self._doc_bucket.get(doc)
+            if db is not None and k > 0:
+                kd = db.grant(k, now, self.scale)
+                if kd < k:
+                    # over-granted tenant tokens for the doc-shed tail:
+                    # refund so the tenant is not double-charged
+                    if tb is not None:
+                        tb.tokens += k - kd
+                    k, reason = kd, "doc_budget"
+            self.admitted_total += k
+            st = self._tenant_stats.setdefault(
+                tenant, {"admitted": 0, "shed": 0})
+            st["admitted"] += k
+            if k > 0:
+                self.registry.inc("admission_admitted_total", k)
+            if k < n:
+                shed = n - k
+                self.shed_total += shed
+                st["shed"] += shed
+                self.registry.inc("admission_shed_total", shed)
+                self.registry.inc(f"admission_shed_{reason}_total", shed)
+                return Admission(k, self._retry_locked(tenant, doc,
+                                                       n - k, now),
+                                 reason)
+            return Admission(k, 0.0, "ok")
+
+    def retry_after_ms(self, client_id: Any, doc: Hashable = None,
+                       n: int = 1, now: Optional[float] = None) -> float:
+        """Pure retry hint for ``n`` ops (consumes nothing) — the
+        doors use it for fence-blocked batches that were never offered
+        to the buckets."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            return self._retry_locked(
+                self._tenant_of.get(client_id, f"client-{client_id}"),
+                doc, n, now)
+
+    def _retry_locked(self, tenant: str, doc: Hashable, n: int,
+                      now: float) -> float:
+        hint = _MIN_RETRY_MS
+        tb = self._tenant_bucket.get(tenant)
+        if tb is not None:
+            hint = max(hint, tb.retry_after_ms(n, now, self.scale))
+        db = self._doc_bucket.get(doc)
+        if db is not None:
+            hint = max(hint, db.retry_after_ms(n, now, self.scale))
+        return round(hint, 3)
+
+    def _estimate_locked(self, backlog: int) -> float:
+        rate = self._service_rate
+        if not rate or backlog <= 0:
+            return 0.0
+        return backlog / rate * 1000.0
+
+    def _shed_locked(self, tenant: str, n: int, reason: str,
+                     retry: float) -> Admission:
+        self.shed_total += n
+        st = self._tenant_stats.setdefault(
+            tenant, {"admitted": 0, "shed": 0})
+        st["shed"] += n
+        self.registry.inc("admission_shed_total", n)
+        self.registry.inc(f"admission_shed_{reason}_total", n)
+        return Admission(0, retry, reason)
+
+    # ------------------------------------------------------------- reporting
+
+    def snapshot(self) -> dict:
+        """Controller state for reports: totals, knobs, per-tenant
+        admitted/shed splits."""
+        with self._lock:
+            return {
+                "admitted_total": self.admitted_total,
+                "shed_total": self.shed_total,
+                "scale": self.scale,
+                "shed_probability": self.shed_probability,
+                "service_rate_ops_s": self._service_rate,
+                "tenants": {t: dict(st)
+                            for t, st in self._tenant_stats.items()},
+            }
+
+
+class ControlPolicy:
+    """AIMD closed loop: SLO burn → brake, recovery → release.
+
+    Each :meth:`tick` reads the :class:`~fluidframework_tpu.utils.slo.
+    SLOEngine` scorecard (side-effect-free — the policy reacting to a
+    burn must not itself fire breach dumps). While ANY judged objective
+    is burning on both its fast and slow windows, the budget scale is
+    cut multiplicatively and the shed probability stepped up; on a
+    healthy tick both recover additively toward wide open. The standard
+    AIMD shape: convergence to fairness, fast reaction, gentle probe
+    back.
+    """
+
+    def __init__(self, admission: AdmissionController, engine,
+                 decrease: float = 0.5, increase: float = 0.1,
+                 shed_step: float = 0.2, max_shed: float = 0.9,
+                 min_scale: float = 0.05):
+        self.admission = admission
+        self.engine = engine
+        self.decrease = decrease
+        self.increase = increase
+        self.shed_step = shed_step
+        self.max_shed = max_shed
+        self.min_scale = min_scale
+        self.scale = 1.0
+        self.shed_probability = 0.0
+        self.ticks = 0
+        self.breach_ticks = 0
+        self.min_scale_seen = 1.0
+        self.max_shed_seen = 0.0
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """One control step; call after the store's ``tick()`` sampled
+        fresh metrics. Returns what moved (for sim traces)."""
+        rows = self.engine.scorecard(now)
+        burning = sorted({r["slo"] for r in rows
+                          if r.get("judged") and not r["ok"]})
+        self.ticks += 1
+        if burning:
+            self.breach_ticks += 1
+            self.scale = max(self.min_scale, self.scale * self.decrease)
+            self.shed_probability = min(
+                self.max_shed, self.shed_probability + self.shed_step)
+            REGISTRY.inc("admission_policy_brake_total")
+        else:
+            self.scale = min(1.0, self.scale + self.increase)
+            self.shed_probability = max(
+                0.0, self.shed_probability - self.shed_step)
+        self.min_scale_seen = min(self.min_scale_seen, self.scale)
+        self.max_shed_seen = max(self.max_shed_seen,
+                                 self.shed_probability)
+        self.admission.set_pressure(self.scale, self.shed_probability)
+        return {"burning": burning, "scale": round(self.scale, 4),
+                "shed_probability": round(self.shed_probability, 4)}

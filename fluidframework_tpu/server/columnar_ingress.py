@@ -14,9 +14,12 @@ missing #5).
 Protocol (little-endian, own framing: u8 type + u32 len + payload +
 crc32):
 
-- type ``J``: JSON control — {"t": "join", "docs": [...]} → {"t":
-  "joined", "client_id", "rows": {doc: row}}; ack frames {"t": "acks",
-  "acks": [[client_seq, seq], ...]} (seq < 0 = nack code).
+- type ``J``: JSON control — {"t": "join", "docs": [...], "tenant"?} →
+  {"t": "joined", "client_id", "rows": {doc: row}}; ack frames {"t":
+  "acks", "acks": [[client_seq, seq], ...]} (seq < 0 = nack code);
+  admission-shed ops answer with {"t": "throttled", "rows": [...],
+  "cseqs": [...], "retry_after_ms"} — resubmit the SAME cseqs after the
+  hint (see ``server.admission``).
 - type ``B``: op batch — u8 n_texts, per text (u16 len + utf-8 bytes),
   then N × 16-byte records ``row u16 | kind u8 | a0 u16 | a1 u16 |
   tidx u8 | cseq u32 | ref u32`` (kind codes:
@@ -308,8 +311,18 @@ class _ColSession:
                 srv._note_rx(self, len(chunk))
                 if len(self.rx) >= srv.max_rx_bytes:
                     self._resume.clear()
+                    # backpressure stall made visible: count every pause
+                    # episode, gauge how many readers are parked NOW
+                    srv.rx_pauses += 1
+                    srv._rx_paused_now += 1
+                    REGISTRY.inc("columnar_rx_paused_total")
+                    REGISTRY.set_gauge("rx_paused",
+                                       float(srv._rx_paused_now))
                     srv._wake_soon()
                     await self._resume.wait()
+                    srv._rx_paused_now -= 1
+                    REGISTRY.set_gauge("rx_paused",
+                                       float(srv._rx_paused_now))
         finally:
             srv._sessions.discard(self)
             # complete frames that arrived before EOF still drain (the
@@ -383,6 +396,8 @@ class _ColSession:
             if self.client_id is None:
                 self.client_id = srv._next_client
                 srv._next_client += 1
+            if srv.admission is not None:
+                srv.admission.bind(self.client_id, req.get("tenant"))
             rows = {}
             lcs = {}
             for d in req["docs"]:
@@ -417,8 +432,22 @@ class ColumnarAlfred:
                  window_min_rows: int = 512, window_ms: float = 2.0,
                  pipeline_depth: int = 2, epoch: int = 0,
                  decode: str = "auto", max_rx_bytes: int = 8 << 20,
-                 read_chunk: int = 256 << 10):
+                 read_chunk: int = 256 << 10, admission=None):
         self.engine = engine
+        #: optional server.admission.AdmissionController: decoded op
+        #: planes are offered to it in the drain pass, BEFORE windows
+        #: reach the executor; shed suffixes get a throttled frame
+        self.admission = admission
+        #: (client_id, row) → lowest shed-but-unreadmitted cseq (suffix
+        #: discipline across drain passes — see _admit_planes)
+        self._shed_fence: Dict[Tuple[int, int], int] = {}
+        #: highest cseq shed in each (client, row) fence run: a full
+        #: readmit of a PREFIX of the run advances the fence instead of
+        #: clearing it (retry waves may resend only part of the run)
+        self._shed_high: Dict[Tuple[int, int], int] = {}
+        self.throttled_ops = 0
+        self.rx_pauses = 0
+        self._rx_paused_now = 0
         self.host = host
         self.port = port
         # restart generation: bumped by whoever restarts the door after a
@@ -650,6 +679,10 @@ class ColumnarAlfred:
             row, kind, a0, a1 = (x[ok] for x in (row, kind, a0, a1))
             gidx, cseq, ref, client = (x[ok] for x in
                                        (gidx, cseq, ref, client))
+        if row.size and self.admission is not None:
+            row, kind, a0, a1, gidx, cseq, ref, client = \
+                self._admit_planes(sess, row, kind, a0, a1, gidx,
+                                   cseq, ref, client)
         if row.size:
             self._parts.append({"sess": sess, "row": row, "kind": kind,
                                 "a0": a0, "a1": a1, "gidx": gidx,
@@ -659,6 +692,85 @@ class ColumnarAlfred:
         if fatal is not None:
             sess._fatal(fatal)
             rx.clear()
+
+    def _admit_planes(self, sess: _ColSession, row, kind, a0, a1,
+                      gidx, cseq, ref, client):
+        """Offer one session's decoded planes to admission, per (client,
+        row) group in arrival order; shed suffixes only (the sequencer
+        nacks clientSeq gaps) and answer every shed op with ONE
+        throttled frame carrying the worst retry hint. A shed fence per
+        (client, row) persists across drain passes: higher cseqs keep
+        shedding until the fenced cseq itself is readmitted, so the
+        client's ordered resubmit can never land behind a gap."""
+        adm = self.admission
+        keep = np.ones(row.size, bool)
+        shed_rows: List[int] = []
+        shed_cseqs: List[int] = []
+        retry = 0.0
+        cid = int(client[0])     # one session = one client per part
+        for r in np.unique(row).tolist():
+            idx = np.flatnonzero(row == r)
+            key = (cid, r)
+            fence = self._shed_fence.get(key)
+            if fence is not None:
+                if int(cseq[idx[0]]) > fence:
+                    # the fenced cseq has not been resubmitted yet: the
+                    # whole group is behind the gap — shed it all
+                    # without offering (tokens stay for the fence's
+                    # resubmit)
+                    keep[idx] = False
+                    shed_rows += [r] * idx.size
+                    shed_cseqs += cseq[idx].tolist()
+                    self._shed_high[key] = max(
+                        self._shed_high.get(key, 0),
+                        int(cseq[idx[-1]]))
+                    retry = max(retry,
+                                adm.retry_after_ms(cid, r, idx.size))
+                    continue
+                # cseqs below the fence are stale duplicates of already
+                # sequenced ops (everything under the fence admitted
+                # contiguously): keep them for the dedup ledger
+                # UNCHARGED and offer only the fenced suffix. Offering
+                # a duplicate could admit it and clear the fence,
+                # letting a higher live cseq skip the still-shed
+                # fenced op into a clientSeq-gap nack.
+                idx = idx[cseq[idx] >= fence]
+                if idx.size == 0:
+                    continue
+            res = adm.admit(cid, r, int(idx.size),
+                            backlog=self._pending_ops + len(shed_cseqs))
+            k = res.admitted
+            if k < idx.size:
+                self._shed_fence[key] = int(cseq[idx[k]])
+                self._shed_high[key] = max(self._shed_high.get(key, 0),
+                                           int(cseq[idx[-1]]))
+                shed = idx[k:]
+                keep[shed] = False
+                shed_rows += row[shed].tolist()
+                shed_cseqs += cseq[shed].tolist()
+                retry = max(retry, res.retry_after_ms)
+            elif fence is not None:
+                # whole group admitted — but a retry wave may carry
+                # only a PREFIX of the shed run; advance the fence past
+                # what just landed until the run's high-water readmits,
+                # so a racing live cseq cannot skip the parked rest
+                last = int(cseq[idx[-1]])
+                if last < self._shed_high.get(key, 0):
+                    self._shed_fence[key] = last + 1
+                else:
+                    del self._shed_fence[key]
+                    self._shed_high.pop(key, None)
+        if shed_cseqs:
+            self.throttled_ops += len(shed_cseqs)
+            REGISTRY.inc("columnar_throttled_ops", len(shed_cseqs))
+            sess._push_json({"t": "throttled", "rows": shed_rows,
+                             "cseqs": shed_cseqs,
+                             "retry_after_ms": round(
+                                 max(retry, 1.0), 3)})
+            row, kind, a0, a1 = (x[keep] for x in (row, kind, a0, a1))
+            gidx, cseq, ref, client = (x[keep] for x in
+                                       (gidx, cseq, ref, client))
+        return row, kind, a0, a1, gidx, cseq, ref, client
 
     def _build_windows(self) -> List[dict]:
         """Carve the pass's decoded backlog into unique-row windows:
@@ -785,6 +897,10 @@ class ColumnarAlfred:
         sessi, tab = w["sessi"], w["tab"]
         self.engine.note_acked_planes(rows, w["client"].reshape(-1),
                                       cseq, seqs)
+        if self.admission is not None:
+            # service-rate feedback for the deadline estimator: these
+            # ops just finished sequencing + durable append
+            self.admission.note_served(int(rows.size))
         order = np.argsort(sessi, kind="stable")
         ss = sessi[order]
         cuts = np.flatnonzero(np.diff(ss)) + 1

@@ -50,6 +50,20 @@ class _Session:
         self._nacks_seen = 0
         self._dups_seen = 0
         self._evicted = False
+        #: lowest shed-but-not-yet-readmitted clientSeq: once an op is
+        #: throttled, every HIGHER cseq must throttle too until the
+        #: fenced one is admitted (the sequencer nacks clientSeq gaps,
+        #: so suffix-only shedding is a correctness rule, not a policy)
+        self._shed_fence: Optional[int] = None
+        #: highest clientSeq shed in the current fence run: admitting
+        #: the fenced cseq ADVANCES the fence here instead of clearing
+        #: it (see _admit_op) — a client retry wave may resend only a
+        #: PREFIX of its parked run, and a live submit racing in after
+        #: that prefix readmits must not skip the still-parked rest
+        self._shed_high = 0
+        #: ops shed behind the current fence — retry hints scale with
+        #: it so the client's backoff covers its whole parked backlog
+        self._fence_depth = 0
         #: resilient sessions keep their service seat across socket loss:
         #: the client reclaims it via ``resync`` instead of re-joining
         #: (a re-join would reset the sequencer's dedup state)
@@ -146,9 +160,18 @@ class _Session:
         if t == "connect":
             self.conn = svc.connect(req["doc"])
             self.resilient = bool(req.get("resilient"))
+            if self.server.admission is not None:
+                self.server.admission.bind(self.conn.client_id,
+                                           req.get("tenant"))
             self._attach_stream()
+            # current doc seq rides the hello: a client joining a
+            # long-lived doc must reference live state from its FIRST
+            # op — ref_seq 0 would sit below the collab window floor
+            # and nack REF_SEQ_BELOW_MSN before any broadcast arrives
+            deli = getattr(svc, "deli", None)
+            seq = deli.doc_seq(req["doc"]) if deli is not None else 0
             self._push({"t": "connected", "client_id": self.conn.client_id,
-                        "epoch": getattr(svc, "epoch", 0)})
+                        "epoch": getattr(svc, "epoch", 0), "seq": seq})
         elif t == "resync":
             # session resumption: re-bind an existing client identity to
             # this socket, hand back the catch-up tail plus the dedup
@@ -157,6 +180,8 @@ class _Session:
             doc, client_id = req["doc"], req["client_id"]
             self.conn = svc.reconnect(doc, client_id)
             self.resilient = True
+            if self.server.admission is not None:
+                self.server.admission.bind(client_id, req.get("tenant"))
             self._nacks_seen = self._dups_seen = 0
             self._attach_stream()
             REGISTRY.inc("session_reconnects_total")
@@ -170,6 +195,19 @@ class _Session:
             if self.conn is None:
                 await self._error("not connected")
                 return False
+            adm = self.server.admission
+            if adm is not None:
+                retry = self._admit_op(adm, req)
+                if retry is not None:
+                    # explicit refusal, never a silent drop: the op was
+                    # shed BEFORE the sequencer saw its clientSeq, so
+                    # the client resubmits the same number after backoff
+                    REGISTRY.inc("ingress_throttled_ops")
+                    self._push({"t": "throttled",
+                                "doc_id": self.conn.doc_id,
+                                "client_seq": req.get("client_seq", 0),
+                                "retry_after_ms": retry})
+                    return True
             REGISTRY.inc("ingress_ops")
             # the frame carried the client's wire-span context across the
             # socket: re-attach so the synchronous pipeline (deli → apply
@@ -181,6 +219,8 @@ class _Session:
                                      MessageType(req.get("type", 0)),
                                      req.get("ref_seq", 0),
                                      req.get("address"))
+            if adm is not None:
+                adm.note_served(1)
             self._drain_nacks()
         elif t == "signal":
             if self.conn is None:
@@ -206,6 +246,53 @@ class _Session:
             await self._error(f"unknown request {t!r}")
             return False
         return True
+
+    def _admit_op(self, adm, req: dict) -> Optional[float]:
+        """Offer one op to the admission controller. Returns the
+        ``retry_after_ms`` hint when the op is shed, None when admitted.
+        Suffix discipline via the shed fence: once cseq F is refused,
+        every higher cseq is refused too until F itself is admitted —
+        otherwise the resubmit of F would land behind already-sequenced
+        higher cseqs and nack as a clientSeq gap."""
+        cs = int(req.get("client_seq", 0))
+        if self._shed_fence is not None:
+            if cs > self._shed_fence:
+                self._shed_high = max(self._shed_high, cs)
+                self._fence_depth += 1
+                return adm.retry_after_ms(self.conn.client_id,
+                                          self.conn.doc_id,
+                                          n=self._fence_depth)
+            if cs < self._shed_fence:
+                # stale duplicate: everything below the fence was
+                # admitted contiguously, so this cseq is already
+                # sequenced — pass it to the dedup ledger uncharged.
+                # Offering it to the buckets instead could ADMIT it and
+                # clear the fence, letting a higher live cseq skip the
+                # still-shed fenced op into a clientSeq-gap nack.
+                return None
+        res = adm.admit(self.conn.client_id, self.conn.doc_id, 1,
+                        deadline_ms=req.get("deadline_ms"))
+        if res.admitted:
+            if self._shed_fence is not None and cs < self._shed_high:
+                # the run [cs+1 .. _shed_high] was shed after the fenced
+                # op and is still parked client-side. A retry wave may
+                # resend only a PREFIX of it (the client's reader can
+                # lag its timer under load), so ADVANCE the fence op by
+                # op instead of clearing it — a live cseq past the run
+                # must keep shedding until the whole run has landed
+                self._shed_fence = cs + 1
+                self._fence_depth = self._shed_high - cs
+            else:
+                self._shed_fence = None
+                self._fence_depth = 0
+                self._shed_high = 0
+            return None
+        if self._shed_fence is None or cs < self._shed_fence:
+            self._shed_fence = cs
+        self._shed_high = max(self._shed_high, cs)
+        self._fence_depth += 1
+        return adm.retry_after_ms(self.conn.client_id, self.conn.doc_id,
+                                  n=self._fence_depth)
 
     def _attach_stream(self) -> None:
         self.conn.on_op(lambda m: self._push(
@@ -234,11 +321,14 @@ class AlfredServer:
 
     def __init__(self, service: Optional[LocalService] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 max_outbound: int = 4096):
+                 max_outbound: int = 4096, admission=None):
         self.service = service if service is not None else LocalService()
         self.host = host
         self.port = port
         self.max_outbound = max_outbound
+        #: optional server.admission.AdmissionController: ops are offered
+        #: to it before the sequencer; shed ops get a throttled frame
+        self.admission = admission
         self.evictions = 0  # slow-client disconnects (observability)
         self._server: Optional[asyncio.AbstractServer] = None
 

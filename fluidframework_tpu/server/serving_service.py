@@ -80,6 +80,9 @@ class ServingLocalService(LocalService):
                 # shed op, warn once per channel — the round-5 failure mode
                 # was exactly this branch returning None with no trace.
                 self.metrics.inc("replica_ops_dropped")
+                # canonical shed counter (default SLO holds it at zero:
+                # replica-full shedding must page, not just warn once)
+                self.metrics.inc("replica_sheds_total")
                 if key not in self._dropped_channels:
                     self._dropped_channels.add(key)
                     self.metrics.inc("replica_channels_dropped")

@@ -19,10 +19,14 @@ client → server:
     {"t": "summary_put", "doc", "summary", "seq"}
     {"t": "disconnect"}
 server → client:
-    {"t": "connected", "client_id", "epoch"}
+    {"t": "connected", "client_id", "epoch", "seq"}
     {"t": "op", "msg": <sequenced message>}     the broadcast stream
     {"t": "nack", ...}
     {"t": "dup_ack", "doc_id", "client_seq", "seq"}   idempotent re-ack
+    {"t": "throttled", "doc_id", "client_seq", "retry_after_ms"}
+        admission-shed op (never a silent drop): the op was refused
+        BEFORE the sequencer saw its clientSeq, so the client resubmits
+        the SAME number after the hinted backoff (``server.admission``)
     {"t": "signal", ...}
     {"t": "resynced", "client_id", "epoch", "last_client_seq", "msgs"}
     {"t": "deltas_result", "msgs": [...]}
@@ -40,6 +44,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import time
 import zlib
 from typing import Any, Optional
 
@@ -162,19 +167,50 @@ def send_frame(sock: socket.socket, obj: Any) -> None:
     sock.sendall(encode_frame(obj))
 
 
-def recv_exact(sock: socket.socket, n: int) -> bytes:
+def recv_exact(sock: socket.socket, n: int,
+               deadline: Optional[float] = None) -> bytes:
+    """Read exactly ``n`` bytes. With ``deadline`` (a ``time.monotonic``
+    instant) each recv blocks in the KERNEL for at most the remaining
+    budget — no polling loop — and expiry raises :class:`WireError`.
+    The socket's timeout is mutated while a deadline is active; use
+    :func:`recv_frame`'s ``timeout=`` for restore-on-exit semantics."""
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise WireError(
+                    f"recv deadline exceeded ({n - len(buf)} bytes short)")
+            sock.settimeout(remaining)
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            raise WireError("recv deadline exceeded") from None
         if not chunk:
             raise WireError("connection closed mid-frame")
         buf += chunk
     return buf
 
 
-def recv_frame(sock: socket.socket) -> Any:
-    length, crc = decode_header(recv_exact(sock, _HEADER.size))
-    return decode_payload(recv_exact(sock, length), crc)
+def recv_frame(sock: socket.socket,
+               timeout: Optional[float] = None) -> Any:
+    """Read one frame; ``timeout`` bounds the WHOLE frame (header +
+    payload) against one deadline and restores the socket's previous
+    timeout before returning."""
+    if timeout is None:
+        length, crc = decode_header(recv_exact(sock, _HEADER.size))
+        return decode_payload(recv_exact(sock, length), crc)
+    deadline = time.monotonic() + timeout
+    prev = sock.gettimeout()
+    try:
+        length, crc = decode_header(
+            recv_exact(sock, _HEADER.size, deadline))
+        return decode_payload(recv_exact(sock, length, deadline), crc)
+    finally:
+        try:
+            sock.settimeout(prev)
+        except OSError:
+            pass
 
 
 # -------------------------------------------------------- message codecs
@@ -212,15 +248,21 @@ def nack_from_wire(d: dict) -> Nack:
 
 
 def wait_for_port(host: str, port: int, timeout: float = 10.0) -> None:
-    """Block until a TCP server is accepting on (host, port)."""
-    import time
+    """Block until a TCP server is accepting on (host, port). Sleeps are
+    bounded by the REMAINING deadline (a refused connect near expiry
+    must not overshoot the budget by a whole poll interval)."""
     deadline = time.monotonic() + timeout
     last: Optional[Exception] = None
-    while time.monotonic() < deadline:
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
         try:
-            with socket.create_connection((host, port), timeout=1.0):
+            with socket.create_connection(
+                    (host, port), timeout=max(0.05, min(1.0, remaining))):
                 return
         except OSError as e:
             last = e
-            time.sleep(0.05)
+            time.sleep(max(0.0, min(0.05,
+                                    deadline - time.monotonic())))
     raise TimeoutError(f"no server on {host}:{port} after {timeout}s: {last}")

@@ -250,7 +250,7 @@ class SLOEngine:
 def default_slos() -> List[SLOSpec]:
     """The stack's standing objectives (docs/OBSERVABILITY.md table):
     ack latency under budget, zero apply stalls, digest parity holding,
-    and a quiet flight recorder."""
+    a quiet flight recorder, and zero replica-full sheds."""
     return [
         SLOSpec.parse("ack_p99_ms < 200", name="ack_latency"),
         SLOSpec.parse("rate(*apply_stalls) == 0", name="apply_stall_rate"),
@@ -258,6 +258,10 @@ def default_slos() -> List[SLOSpec]:
                       min_samples=1),
         SLOSpec.parse("rate(flight_dump_total) == 0",
                       name="flight_dump_rate"),
+        # replica-full shedding degrades device serving silently unless
+        # it pages: any nonzero shed rate is a breach
+        SLOSpec.parse("rate(*replica_sheds_total) == 0",
+                      name="replica_shed_rate"),
     ]
 
 

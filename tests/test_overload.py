@@ -1,0 +1,406 @@
+"""Overload protection plane (ISSUE 16): token-bucket admission, AIMD
+control policy, THROTTLED handling in both resilient drivers, and the
+multi-tenant simulator's acceptance gates.
+
+The contract under test: **shed work is never silently dropped and
+never burns a clientSeq** — a throttled op is parked client-side and
+resubmitted with the SAME number after the hinted backoff, so the
+durable stream stays gapless and exactly-once even while the admission
+plane refuses most of the offered load.
+"""
+
+import importlib.util
+import os
+import random
+import socket
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.drivers.resilient import (
+    ResilientColumnarClient, ResilientConnection,
+)
+from fluidframework_tpu.core.protocol import MessageType
+from fluidframework_tpu.server import native_deli, wire
+from fluidframework_tpu.server.admission import (
+    Admission, AdmissionController, ControlPolicy, TokenBucket,
+)
+from fluidframework_tpu.server.ingress import AlfredServer
+from fluidframework_tpu.server.tinylicious import LocalService
+from fluidframework_tpu.utils.backoff import Backoff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.overload
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    # visible in sys.modules BEFORE exec: the tool's dataclasses
+    # resolve string annotations through sys.modules[cls.__module__]
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ token bucket
+
+
+class TestTokenBucket:
+    def test_prefix_grant_consumes_exactly_what_it_grants(self):
+        tb = TokenBucket(10.0, burst=5.0)
+        assert tb.grant(3, now=0.0) == 3          # burst covers it
+        assert tb.grant(4, now=0.0) == 2          # prefix of the rest
+        assert tb.grant(1, now=0.0) == 0          # empty
+        assert tb.grant(5, now=1.0) == 5          # 10/s refill for 1s
+
+    def test_refill_caps_at_burst(self):
+        tb = TokenBucket(100.0, burst=4.0)
+        tb.grant(4, now=0.0)
+        assert tb.grant(100, now=10.0) == 4       # never past burst
+
+    def test_scale_multiplies_rate_and_burst(self):
+        tb = TokenBucket(10.0, burst=10.0)
+        tb.grant(10, now=0.0)
+        # half scale: 5/s refill against a 5-token ceiling
+        assert tb.grant(100, now=1.0, scale=0.5) == 5
+
+    def test_retry_after_math_floor_and_cap(self):
+        tb = TokenBucket(10.0, burst=2.0)
+        assert tb.retry_after_ms(1, now=0.0) == 5.0        # have tokens
+        tb.grant(2, now=0.0)
+        assert tb.retry_after_ms(1, now=0.0) == \
+            pytest.approx(100.0)                           # 1 / 10/s
+        assert tb.retry_after_ms(1000, now=0.0) == 2000.0  # ceiling
+
+    def test_rejects_nonpositive_rate(self):
+        with pytest.raises(ValueError):
+            TokenBucket(0.0)
+
+
+# ----------------------------------------------------- admission controller
+
+
+class TestAdmissionController:
+    def _adm(self, **kw):
+        return AdmissionController(rng=random.Random(7), **kw)
+
+    def test_prefix_grant_and_retry_hint(self):
+        adm = self._adm(tenants={"t": 10.0})
+        adm.bind("c1", "t")
+        res = adm.admit("c1", "d", 14, now=0.0)
+        assert isinstance(res, Admission)
+        assert res.admitted == 10 and res.reason == "budget"
+        assert res.retry_after_ms >= 5.0
+        assert adm.snapshot()["tenants"]["t"] == \
+            {"admitted": 10, "shed": 4}
+
+    def test_unknown_tenant_without_default_is_unbudgeted(self):
+        adm = self._adm()
+        assert adm.admit("nobody", "d", 1000, now=0.0).admitted == 1000
+
+    def test_default_rate_auto_buckets_new_tenants(self):
+        adm = self._adm(default_rate=5.0)
+        adm.bind("c1", "fresh")
+        assert adm.admit("c1", "d", 9, now=0.0).admitted == 5
+
+    def test_doc_bucket_refunds_tenant_tokens(self):
+        adm = self._adm(tenants={"t": 100.0})
+        adm.bind("c1", "t")
+        adm.set_doc_rate("hot", 100.0, burst=2.0)
+        res = adm.admit("c1", "hot", 5, now=0.0)
+        assert res.admitted == 2 and res.reason == "doc_budget"
+        # the 3 doc-shed ops must not stay charged to the tenant
+        assert adm._tenant_bucket["t"].tokens == pytest.approx(98.0)
+
+    def test_inflight_gate_sheds_whole_batch(self):
+        adm = self._adm(max_inflight_ops=5)
+        res = adm.admit("c1", "d", 3, backlog=6, now=0.0)
+        assert res.admitted == 0 and res.reason == "inflight"
+
+    def test_deadline_shed_needs_evidence(self):
+        adm = self._adm(deadline_ms=50.0)
+        # estimator unfed: absence of evidence never sheds
+        assert adm.admit("c1", "d", 1, backlog=10 ** 6,
+                         now=0.0).admitted == 1
+        adm.note_served(10, now=0.0)
+        adm.note_served(10, now=1.0)              # EWMA ~10 ops/s
+        res = adm.admit("c1", "d", 1, backlog=100, now=1.0)
+        assert res.admitted == 0 and res.reason == "deadline"
+        # per-op deadline overrides the default budget
+        assert adm.admit("c1", "d", 1, backlog=100, now=1.0,
+                         deadline_ms=60_000.0).admitted == 1
+
+    def test_pressure_gate_is_seeded_and_scaled(self):
+        adm = self._adm(tenants={"t": 1000.0})
+        adm.bind("c1", "t")
+        adm.set_pressure(shed_probability=1.0)
+        res = adm.admit("c1", "d", 4, now=0.0)
+        assert res.admitted == 0 and res.reason == "pressure"
+        # quarter scale: refill rate AND ceiling shrink to 250/s / 250
+        adm2 = self._adm(tenants={"t": 1000.0})
+        adm2.bind("c1", "t")
+        adm2.admit("c1", "d", 1000, now=0.0)      # drain initial burst
+        adm2.set_pressure(scale=0.25)
+        assert adm2.admit("c1", "d", 1000, now=1.0).admitted == 250
+
+    def test_retry_after_ms_is_pure(self):
+        adm = self._adm(tenants={"t": 10.0})
+        adm.bind("c1", "t")
+        before = adm._tenant_bucket["t"].tokens
+        hint = adm.retry_after_ms("c1", "d", n=100, now=0.0)
+        assert hint > 5.0
+        assert adm._tenant_bucket["t"].tokens == before
+        assert adm.snapshot()["shed_total"] == 0
+
+
+# --------------------------------------------------------- control policy
+
+
+class _FakeEngine:
+    """SLOEngine stand-in: one judged objective, burn switchable."""
+
+    def __init__(self):
+        self.burning = True
+
+    def scorecard(self, now=None):
+        return [{"slo": "ack_p99", "judged": True,
+                 "ok": not self.burning}]
+
+
+class TestControlPolicy:
+    def test_aimd_brakes_multiplicatively_recovers_additively(self):
+        adm = AdmissionController()
+        eng = _FakeEngine()
+        pol = ControlPolicy(adm, eng)
+        pol.tick()
+        assert adm.scale == pytest.approx(0.5)
+        assert adm.shed_probability == pytest.approx(0.2)
+        pol.tick()
+        assert adm.scale == pytest.approx(0.25)
+        assert adm.shed_probability == pytest.approx(0.4)
+        eng.burning = False
+        pol.tick()
+        assert adm.scale == pytest.approx(0.35)
+        assert adm.shed_probability == pytest.approx(0.2)
+        assert pol.ticks == 3 and pol.breach_ticks == 2
+        assert pol.min_scale_seen == pytest.approx(0.25)
+        assert pol.max_shed_seen == pytest.approx(0.4)
+
+    def test_floors_and_ceilings_hold(self):
+        adm = AdmissionController()
+        eng = _FakeEngine()
+        pol = ControlPolicy(adm, eng, min_scale=0.1, max_shed=0.5)
+        for _ in range(20):
+            pol.tick()
+        assert adm.scale == pytest.approx(0.1)
+        assert adm.shed_probability == pytest.approx(0.5)
+        eng.burning = False
+        for _ in range(20):
+            pol.tick()
+        assert adm.scale == pytest.approx(1.0)
+        assert adm.shed_probability == pytest.approx(0.0)
+
+
+# ------------------------------------------------------- backoff guarantees
+
+
+class TestBackoffJitter:
+    def test_delay_bounds_decorrelated(self):
+        bo = Backoff(base=0.01, cap=0.8, rng=random.Random(9))
+        prev = bo.base
+        for _ in range(200):
+            d = bo.next_delay()
+            assert 0.01 <= d <= 0.8
+            assert d <= max(prev * 3, 0.01) + 1e-12
+            prev = max(0.01, d)
+
+    def test_seeded_schedule_replays_and_reset(self):
+        a = Backoff(base=0.02, cap=1.0, rng=random.Random(4))
+        b = Backoff(base=0.02, cap=1.0, rng=random.Random(4))
+        assert [a.next_delay() for _ in range(16)] == \
+            [b.next_delay() for _ in range(16)]
+        a.reset()
+        assert a.next_delay() <= 0.06          # episode forgot growth
+
+
+# -------------------------------------------------------- wire timeouts
+
+
+class TestWireTimeouts:
+    def test_recv_frame_timeout_raises_wire_error(self):
+        a, b = socket.socketpair()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(wire.WireError):
+                wire.recv_frame(a, timeout=0.15)
+            assert time.monotonic() - t0 < 2.0   # bounded, no busy-wait
+            assert a.gettimeout() is None        # restored
+        finally:
+            a.close()
+            b.close()
+
+    def test_recv_frame_timeout_mid_frame(self):
+        a, b = socket.socketpair()
+        try:
+            frame = wire.encode_frame({"t": "op"})
+            b.sendall(frame[: len(frame) // 2])  # torn: header, no tail
+            with pytest.raises(wire.WireError):
+                wire.recv_frame(a, timeout=0.15)
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------- JSON door THROTTLED e2e
+
+
+class TestJsonDoorThrottle:
+    def test_shed_burst_drains_exactly_once_without_cseq_burn(self):
+        svc = LocalService(n_partitions=2)
+        adm = AdmissionController(tenants={"t": 60.0},
+                                  rng=random.Random(0))
+        adm.register_tenant("t", 60.0, burst=8.0)
+        server = AlfredServer(svc, admission=adm).start_in_thread()
+        try:
+            conn = ResilientConnection("127.0.0.1", server.port, "d0",
+                                       rng=random.Random(1), tenant="t")
+            n = 40
+            uids = [conn.submit({"mt": "insert", "kind": 0, "pos": 0,
+                                 "text": f"x{i}.", "u": i})
+                    for i in range(n)]
+            assert conn.wait_idle(timeout=30), conn.pending_count
+            assert not conn.nacks, conn.nacks     # shed ≠ nacked
+            assert conn.throttled > 0             # burst over budget
+            assert conn.throttle_resubmits > 0
+            assert conn.throttled_uids            # latency bookkeeping
+            assert set(conn.op_acks) == set(uids)
+            durable = [m for m in svc.get_deltas("d0", 0)
+                       if m.type == MessageType.OP]
+            # exactly once, in order, cseqs gapless from 1: a shed op
+            # was resubmitted with the SAME number, never renumbered
+            assert [m.contents["u"] for m in durable] == list(range(n))
+            assert [m.client_seq for m in durable] == \
+                list(range(1, n + 1))
+            assert adm.snapshot()["tenants"]["t"]["shed"] > 0
+            conn.close()
+        finally:
+            server.stop()
+            svc.close()
+
+    def test_throttled_frame_carries_retry_hint(self):
+        svc = LocalService(n_partitions=1)
+        adm = AdmissionController(tenants={"t": 20.0},
+                                  rng=random.Random(0))
+        adm.register_tenant("t", 20.0, burst=2.0)
+        server = AlfredServer(svc, admission=adm).start_in_thread()
+        try:
+            sock = socket.create_connection(("127.0.0.1", server.port))
+            wire.send_frame(sock, {"t": "connect", "doc": "d0",
+                                   "tenant": "t"})
+            hello = wire.recv_frame(sock, timeout=5.0)
+            assert hello["t"] == "connected"
+            for cs in (1, 2, 3, 4):
+                wire.send_frame(sock, {"t": "op", "client_seq": cs,
+                                       "ref_seq": hello.get("seq", 0),
+                                       "type": int(MessageType.OP),
+                                       "contents": {"u": cs}})
+            got = []
+            while len([f for f in got if f["t"] == "throttled"]) < 1:
+                got.append(wire.recv_frame(sock, timeout=5.0))
+            th = [f for f in got if f["t"] == "throttled"][0]
+            assert th["retry_after_ms"] >= 5.0
+            assert th["client_seq"] >= 3          # suffix shed only
+            sock.close()
+        finally:
+            server.stop()
+            svc.close()
+
+
+# ------------------------------------------ columnar door THROTTLED e2e
+
+needs_native = pytest.mark.skipif(not native_deli.available(),
+                                  reason="native sequencer unavailable")
+
+
+@needs_native
+class TestColumnarDoorThrottle:
+    def test_shed_burst_drains_exactly_once(self):
+        from fluidframework_tpu.server.columnar_ingress import (
+            ColumnarAlfred)
+        from fluidframework_tpu.server.serving import StringServingEngine
+        eng = StringServingEngine(n_docs=4, capacity=256,
+                                  batch_window=10 ** 9,
+                                  sequencer="native")
+        adm = AdmissionController(tenants={"t": 80.0},
+                                  rng=random.Random(0))
+        adm.register_tenant("t", 80.0, burst=8.0)
+        srv = ColumnarAlfred(eng, window_min_rows=1, window_ms=2.0,
+                             admission=adm).start_in_thread()
+        try:
+            cl = ResilientColumnarClient("127.0.0.1", srv.port, ["d0"],
+                                         rng=random.Random(3),
+                                         tenant="t")
+            n = 30
+            for i in range(n):
+                cl.submit("d0", kind=0, a0=0, payload=f"w{i}.")
+            assert cl.wait_idle(timeout=30), cl.pending_count
+            assert not cl.nacks, cl.nacks
+            assert cl.throttled > 0
+            assert cl.throttled_cseqs["d0"]
+            assert sorted(cl.acks["d0"]) == list(range(1, n + 1))
+            text = eng.read_text("d0")
+            for i in range(n):
+                assert text.count(f"w{i}.") == 1, (i, text)
+            cl.close()
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------- replica shed counter
+
+
+class TestReplicaShedCounter:
+    def test_replica_full_counts_sheds_and_default_slo_exists(self):
+        from fluidframework_tpu.framework import LocalClient
+        from fluidframework_tpu.server.serving_service import (
+            ServingLocalService)
+        from fluidframework_tpu.utils.slo import default_slos
+        svc = ServingLocalService(n_docs=1, capacity=256)
+        try:
+            client = LocalClient(service=svc)
+            schema = {"initialObjects": {"a": "sharedString",
+                                         "b": "sharedString"}}
+            c1, _doc = client.create_container(schema)
+            c1.initial_objects["a"].insert_text(0, "fits")
+            c1.initial_objects["b"].insert_text(0, "sheds")
+            assert svc.metrics.counters["replica_sheds_total"] >= 1
+            assert svc.metrics.counters["replica_channels_dropped"] == 1
+            assert svc.dropped_channels()
+        finally:
+            svc.close()
+        assert any(s.name == "replica_shed_rate"
+                   for s in default_slos())
+
+
+# -------------------------------------------------- tenant sim soak gate
+
+
+class TestTenantSimGate:
+    def test_quick_profile_holds_correctness_gates(self):
+        ts = _tool("tenant_sim")
+        # lenient latency/goodput floors: tier-1 boxes vary, and the
+        # CORRECTNESS gates (zero silent drops, exactly-once, abusive
+        # overage visibly shed) are the ones that must never flex
+        report = ts.run_sim(seed=3, duration_s=1.2, slo_ms=1000.0,
+                            goodput_min=0.3, quick=True)
+        assert report["silent_drops"] == 0
+        assert report["ops_acked"] == report["ops_offered"]
+        assert report["abusive_throttled"] > 0
+        assert report["abusive_shed"] > 0
+        assert report["throttled_frames"] > 0
+        assert report["gate_failures"] == [], report["gate_failures"]
+        assert report["policy"]["ticks"] > 0

@@ -203,6 +203,24 @@ def reconnect_storm_block(rec: dict) -> str | None:
     return json.dumps(out)
 
 
+def overload_block(rec: dict) -> str | None:
+    """Overload-storm fenced block (ISSUE 16: admission control under
+    2x-capacity multi-tenant load), or None on records predating the
+    phase."""
+    storm = rec.get("overload_storm")
+    if not isinstance(storm, dict):
+        return None
+    out = {"metric": "overload_goodput_ratio", "unit": "ratio"}
+    out.update({k: storm[k] for k in (
+        "goodput_ratio", "admitted_ack_p99_ms", "shed_ratio",
+        "shed_total", "throttled_frames", "throttle_resubmits",
+        "abusive_throttled", "abusive_shed", "ops_offered", "ops_acked",
+        "policy_breach_ticks", "policy_min_scale", "silent_drops",
+        "invariant_violations", "gate_failures",
+        "error") if k in storm})
+    return json.dumps(out)
+
+
 def durability_block(rec: dict) -> str | None:
     """Durability fenced block (ISSUE 10: recovery ladder timings + the
     scrub's chain-break count), or None on records predating the
@@ -256,6 +274,7 @@ def regenerate(root: Path, json_path: Path | None = None,
                            ("## Columnar ingress", ingress_block(rec)),
                            ("## Reconnect storm",
                             reconnect_storm_block(rec)),
+                           ("## Overload storm", overload_block(rec)),
                            ("## Durability", durability_block(rec))):
         if extra is not None:
             updated = update_section(updated, heading, extra)

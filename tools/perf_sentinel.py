@@ -264,6 +264,38 @@ def judge_resilience(rounds: List[dict]) -> List[dict]:
                         "docs/RESILIENCE.md")}]
 
 
+def judge_overload(rounds: List[dict]) -> List[dict]:
+    """Hard gate on the newest round's overload-storm phase (ISSUE 16):
+    like the resilience gate, ``invariant_violations`` and
+    ``silent_drops`` are correctness counts — any nonzero value (or a
+    storm that errored out, recorded as −1) regresses regardless of
+    bands or history. Rounds predating the phase produce no verdict."""
+    if not rounds:
+        return []
+    storm = rounds[-1].get("overload_storm")
+    if not isinstance(storm, dict):
+        return []
+    out: List[dict] = []
+    for key, note_ok, note_bad in (
+            ("invariant_violations",
+             "exactly-once held under admission shedding",
+             "overload invariant broken — see docs/OVERLOAD.md"),
+            ("silent_drops",
+             "every shed op explicitly throttled, none dropped",
+             "shed work silently dropped — see docs/OVERLOAD.md")):
+        v = storm.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        ok = v == 0
+        out.append({"metric": f"overload_storm.{key}",
+                    "verdict": FLAT if ok else REGRESS, "value": v,
+                    "expected": "0 (overload invariant)",
+                    "delta_pct": None,
+                    "note": note_ok if ok
+                    else ("storm errored" if v < 0 else note_bad)})
+    return out
+
+
 def judge_durability(rounds: List[dict],
                      spill_dir: Optional[str] = None) -> List[dict]:
     """Hard gate on durable-layer integrity (ISSUE 10): the newest
@@ -402,6 +434,7 @@ def main(argv=None) -> int:
                      k_sigma=args.k_sigma)
     verdicts += judge_floors(rounds)
     verdicts += judge_resilience(rounds)
+    verdicts += judge_overload(rounds)
     verdicts += judge_durability(rounds, spill_dir=args.spill_dir)
     failed = has_regression(verdicts)
     if args.json:

@@ -1,0 +1,493 @@
+#!/usr/bin/env python
+"""Multi-tenant overload simulator + soak gate (ISSUE 16 tentpole).
+
+Where ``tools/chaos_soak.py`` proves the resilience plane under faults,
+this drives the ADMISSION plane under load: several tenants — mixed
+writer/reader roles, one op family each (string / map / matrix / tree
+from ``testing.chaos.OpGen``) — push paced traffic through resilient
+clients into a real ingress service fronted by a
+:class:`server.admission.AdmissionController`, with the
+:class:`~fluidframework_tpu.server.admission.ControlPolicy` AIMD loop
+ticking against a live SLO scorecard the whole time. One tenant is
+ABUSIVE: it offers a multiple of its declared budget (default 5×), so
+aggregate offered load lands near 2× aggregate capacity.
+
+Traffic shape:
+
+- **Zipf doc popularity** — each session picks its document from a
+  seeded Zipf draw, so a few hot docs absorb most sessions (the shape
+  that makes per-doc budgets meaningful).
+- **bursty arrival/churn** — sessions churn mid-storm (an idle writer
+  retires and a fresh session joins on a new doc draw) and one seeded
+  arrival burst adds sessions to a random tenant; readers churn too.
+- **closed control loop** — a ``TimeSeriesStore`` samples the registry
+  (including the sim's live ``ack_p99_ms`` gauge over recently-acked
+  never-throttled ops) and ``ControlPolicy.tick`` moves the budget
+  scale / shed probability on SLO burn. Only ``scorecard()`` is
+  consulted — the control loop itself never fires breach flight dumps.
+
+After the storm the abusive tenant's budget is re-declared at its
+offered rate (the operator lifting the brake) and every session drains.
+The audit then holds the admission plane to the resilience plane's bar:
+
+1. **zero silent drops** — every offered op is eventually acked; shed
+   ops were parked behind ``throttled`` frames and resubmitted with the
+   SAME clientSeq, never lost, never renumbered;
+2. **exactly-once, in order** — per doc: seqs strictly increasing, no
+   marker appears twice, the durable set equals the acked set, and each
+   session's durable subsequence equals its submission order;
+3. **abusive overage visibly shed** — the abusive tenant saw throttled
+   frames and the controller's per-tenant ledger shows its shed count;
+4. **admitted traffic met its SLO** — p99 ack latency of never-
+   throttled ops is under the objective, and compliant tenants' goodput
+   at storm end is at least ``goodput_min`` of what they offered.
+
+Violations go through ``chaos_soak._violate`` (counter + flight dump +
+:class:`chaos_soak.SoakViolation`). A clean run returns a report dict;
+``--check`` exits 1 unless every gate passes.
+
+Usage::
+
+    python tools/tenant_sim.py --seed 7 --duration 6
+    python tools/tenant_sim.py --quick --check     # the tier-1 profile
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+# sibling tools (chaos_soak's violation machinery) are importable
+# regardless of how this module was loaded (CLI, pytest, bench)
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
+
+import chaos_soak                                                  # noqa: E402
+from fluidframework_tpu.core.protocol import MessageType           # noqa: E402
+from fluidframework_tpu.drivers.resilient import ResilientConnection  # noqa: E402,E501
+from fluidframework_tpu.server.admission import (                  # noqa: E402
+    AdmissionController, ControlPolicy,
+)
+from fluidframework_tpu.server.ingress import AlfredServer         # noqa: E402
+from fluidframework_tpu.server.tinylicious import LocalService     # noqa: E402
+from fluidframework_tpu.testing.chaos import FAMILIES, OpGen       # noqa: E402
+from fluidframework_tpu.utils import slo as slo_mod                # noqa: E402
+from fluidframework_tpu.utils.telemetry import REGISTRY            # noqa: E402
+from fluidframework_tpu.utils.timeseries import TimeSeriesStore    # noqa: E402
+
+_violate = chaos_soak._violate
+SoakViolation = chaos_soak.SoakViolation
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's declared budget and traffic shape. ``load`` is the
+    offered-rate multiplier over the budget: 1.0 is a compliant tenant,
+    anything above deliberately overdrives its bucket (the abusive
+    tenant runs at 5×). ``role`` ``reader`` sessions never submit —
+    they ride the broadcast stream of their Zipf-drawn doc."""
+
+    name: str
+    rate: float                  # declared budget, ops/sec
+    clients: int = 1
+    family: str = "string"
+    role: str = "writer"         # "writer" | "reader"
+    load: float = 1.0
+
+    @property
+    def offered_rate(self) -> float:
+        return self.rate * self.load if self.role == "writer" else 0.0
+
+
+class _Session:
+    """One resilient client session plus its audit ledger."""
+
+    _next = 0
+
+    def __init__(self, spec: TenantSpec, doc: str, port: int,
+                 rng: random.Random):
+        _Session._next += 1
+        self.key = f"{spec.name}.s{_Session._next}"
+        self.spec = spec
+        self.doc = doc
+        self.gen = OpGen(random.Random(rng.randrange(2 ** 31)),
+                         spec.family, [doc])
+        self.submitted: List[str] = []       # markers, in order
+        self.uid_marker: Dict[int, str] = {}
+        self.submit_t: Dict[int, float] = {}
+        self.ack_t: Dict[int, float] = {}
+        self.ops_observed = 0                # reader-side broadcasts
+        self.credit = 0.0
+        on_op = (lambda msg: setattr(
+            self, "ops_observed", self.ops_observed + 1)) \
+            if spec.role == "reader" else None
+        self.conn = ResilientConnection(
+            "127.0.0.1", port, doc,
+            rng=random.Random(rng.randrange(2 ** 31)),
+            tenant=spec.name, on_op=on_op,
+            on_ack=lambda uid, seq: self.ack_t.setdefault(
+                uid, time.monotonic()))
+
+    def offer(self, n: int) -> None:
+        for _ in range(n):
+            i = len(self.submitted)
+            marker = f"{self.key}#{i}"
+            op = dict(self.gen.op(self.doc), u=marker)
+            t0 = time.monotonic()
+            uid = self.conn.submit(op)
+            self.submitted.append(marker)
+            self.uid_marker[uid] = marker
+            self.submit_t[uid] = t0
+
+    def admitted_latencies_ms(self) -> List[float]:
+        """Ack latencies of ops that were NEVER throttled — the
+        admitted-traffic view the latency SLO judges (a shed op's
+        latency includes the deliberate backoff by design)."""
+        shed = self.conn.throttled_uids
+        return [(self.ack_t[u] - self.submit_t[u]) * 1000.0
+                for u in self.ack_t
+                if u not in shed and u in self.submit_t]
+
+
+def _zipf_picker(n_docs: int, exponent: float, rng: random.Random):
+    """Seeded Zipf draw over doc indices: P(k) ∝ 1/(k+1)^s."""
+    weights = [1.0 / (k + 1) ** exponent for k in range(n_docs)]
+    total = sum(weights)
+    cumulative = []
+    acc = 0.0
+    for w in weights:
+        acc += w / total
+        cumulative.append(acc)
+
+    def pick() -> str:
+        r = rng.random()
+        for k, c in enumerate(cumulative):
+            if r <= c:
+                return f"ts-{k}"
+        return f"ts-{n_docs - 1}"
+    return pick
+
+
+def _p99(values: List[float]) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(len(ordered) * 0.99))]
+
+
+@dataclass
+class _Gates:
+    """Acceptance thresholds the --check mode enforces."""
+
+    goodput_min: float = 0.8
+    slo_ms: float = 200.0
+    failures: List[str] = field(default_factory=list)
+
+    def expect(self, ok: bool, what: str) -> None:
+        if not ok:
+            self.failures.append(what)
+
+
+def default_tenants(quick: bool) -> List[TenantSpec]:
+    """Three compliant writers (distinct op families), one reader
+    tenant, one abusive writer at 5× budget: aggregate offered load =
+    (3 + 5) / 4 = 2× aggregate declared capacity."""
+    rate = 60.0 if quick else 150.0
+    return [
+        TenantSpec("acme", rate, clients=2, family=FAMILIES[0]),
+        TenantSpec("blue", rate, clients=2, family=FAMILIES[1]),
+        TenantSpec("casa", rate, clients=1, family=FAMILIES[2]),
+        TenantSpec("dash", rate, clients=2, family=FAMILIES[3],
+                   role="reader"),
+        TenantSpec("evil", rate, clients=1, family=FAMILIES[0],
+                   load=5.0),
+    ]
+
+
+def run_sim(seed: int = 0, duration_s: float = 6.0,
+            tenants: Optional[List[TenantSpec]] = None,
+            n_docs: int = 8, zipf_exponent: float = 1.2,
+            slo_ms: float = 200.0, goodput_min: float = 0.8,
+            control_every_s: float = 0.05, churn_p: float = 0.3,
+            idle_timeout: float = 30.0, quick: bool = False) -> dict:
+    """Run one seeded storm; returns the report dict or raises
+    :class:`SoakViolation` on an audit failure."""
+    rng = random.Random(seed)
+    tenants = tenants if tenants is not None else default_tenants(quick)
+    writers = [t for t in tenants if t.role == "writer"]
+    abusive = [t for t in writers if t.load > 1.0]
+    adm = AdmissionController(
+        tenants={t.name: t.rate for t in writers},
+        rng=random.Random(rng.randrange(2 ** 31)))
+    store = TimeSeriesStore(registry=REGISTRY)
+    engine = slo_mod.SLOEngine(store, specs=[
+        slo_mod.SLOSpec.parse(f"ack_p99_ms < {slo_ms}",
+                              name="admitted_ack_p99",
+                              fast_window_s=0.6, slow_window_s=2.0),
+    ])
+    policy = ControlPolicy(adm, engine)
+    service = LocalService()
+    server = AlfredServer(service, admission=adm).start_in_thread()
+    pick_doc = _zipf_picker(n_docs, zipf_exponent, rng)
+
+    sessions: Dict[str, List[_Session]] = {t.name: [] for t in tenants}
+    retired: List[_Session] = []
+    churns = 0
+    bursts = 0
+    policy_trace: List[dict] = []
+    recent_lat: List[float] = []     # rolling admitted-ack window
+
+    def spawn(spec: TenantSpec) -> None:
+        doc = pick_doc()
+        if spec.role == "reader" and not sessions[spec.name]:
+            doc = "ts-0"     # first reader rides the hottest doc
+        sessions[spec.name].append(_Session(spec, doc, server.port, rng))
+
+    t0 = time.monotonic()
+    try:
+        for spec in tenants:
+            for _ in range(spec.clients):
+                spawn(spec)
+        burst_at = t0 + duration_s * rng.uniform(0.3, 0.6)
+        next_ctl = t0 + control_every_s
+        last = time.monotonic()
+        storm_acked: Dict[str, int] = {}
+        storm_offered: Dict[str, int] = {}
+        while True:
+            now = time.monotonic()
+            if now - t0 >= duration_s:
+                break
+            dt = now - last
+            last = now
+            for spec in writers:
+                active = sessions[spec.name]
+                if not active:
+                    continue
+                per_session = spec.offered_rate / len(active)
+                for sess in active:
+                    sess.credit += per_session * dt
+                    n = int(sess.credit)
+                    if n:
+                        sess.credit -= n
+                        sess.offer(n)
+            if burst_at is not None and now >= burst_at:
+                burst_at = None
+                bursts += 1
+                lucky = writers[rng.randrange(len(writers))]
+                spawn(lucky)
+                spawn(lucky)
+            if now >= next_ctl:
+                next_ctl = now + control_every_s
+                fresh = [lat for sess_list in sessions.values()
+                         for sess in sess_list
+                         for lat in sess.admitted_latencies_ms()]
+                recent_lat = fresh[-512:]
+                REGISTRY.set_gauge("ack_p99_ms", _p99(recent_lat))
+                store.tick(now=now)
+                policy_trace.append(policy.tick(now=now))
+                if rng.random() < churn_p:
+                    spec = tenants[rng.randrange(len(tenants))]
+                    pool = sessions[spec.name]
+                    idle = [s for s in pool
+                            if s.conn.pending_count == 0]
+                    if idle and len(pool) > 1:
+                        churns += 1
+                        gone = idle[rng.randrange(len(idle))]
+                        pool.remove(gone)
+                        gone.conn.close()
+                        retired.append(gone)
+                        spawn(spec)
+            time.sleep(0.002)
+        storm_s = time.monotonic() - t0
+        everyone = retired + [s for pool in sessions.values()
+                              for s in pool]
+        for spec in tenants:
+            mine = [s for s in everyone if s.spec is spec]
+            storm_offered[spec.name] = sum(len(s.submitted)
+                                           for s in mine)
+            storm_acked[spec.name] = sum(len(s.conn.op_acks)
+                                         for s in mine)
+        # drain: the operator lifts the abusive tenant's brake so its
+        # parked backlog clears at the offered rate — every shed op
+        # must still land exactly once, with its ORIGINAL clientSeq
+        for spec in abusive:
+            adm.register_tenant(spec.name, spec.offered_rate * 2.0)
+        adm.set_pressure(scale=1.0, shed_probability=0.0)
+        live = [s for pool in sessions.values() for s in pool]
+        for sess in live:
+            if not sess.conn.wait_idle(timeout=idle_timeout):
+                _violate("drain_timeout", session=sess.key,
+                         pending=sess.conn.pending_count,
+                         throttled=sess.conn.throttled)
+        for sess in everyone:
+            if sess.conn.nacks:
+                _violate("genuine_nack", session=sess.key,
+                         n=len(sess.conn.nacks),
+                         first=sess.conn.nacks[0],
+                         reconnects=sess.conn.reconnects,
+                         resubmits=sess.conn.resubmits,
+                         throttled=sess.conn.throttled,
+                         dup_acked=sess.conn.dup_acked)
+        _audit(service, everyone)
+        return _report(seed, storm_s, tenants, everyone, adm, policy,
+                       storm_offered, storm_acked, recent_lat, churns,
+                       bursts, slo_ms, goodput_min, policy_trace)
+    finally:
+        for pool in sessions.values():
+            for sess in pool:
+                sess.conn.close()
+        server.stop()
+        service.close()
+
+
+def _audit(service: LocalService, everyone: List[_Session]) -> None:
+    """Hold the durable stream to the exactly-once/order bar, with
+    multiple writers per doc: global uniqueness + per-session order."""
+    by_doc: Dict[str, List[_Session]] = {}
+    for sess in everyone:
+        by_doc.setdefault(sess.doc, []).append(sess)
+    for doc, residents in by_doc.items():
+        durable = [m for m in service.get_deltas(doc, 0)
+                   if m.type == MessageType.OP]
+        seqs = [m.seq for m in durable]
+        if any(b <= a for a, b in zip(seqs, seqs[1:])):
+            _violate("seq_not_monotone", doc=doc)
+        markers = [(m.contents or {}).get("u") for m in durable]
+        if len(set(markers)) != len(markers):
+            dup = sorted(m for m in set(markers)
+                         if markers.count(m) > 1)[0]
+            _violate("double_applied", doc=doc, marker=str(dup))
+        acked: Dict[str, int] = {}
+        for sess in residents:
+            for uid, seq in sess.conn.op_acks.items():
+                acked[sess.uid_marker[uid]] = seq
+        for m, seq in zip(markers, seqs):
+            if m not in acked:
+                _violate("stray_unacked_op", doc=doc, marker=str(m))
+            if acked[m] != seq:
+                _violate("ack_seq_mismatch", doc=doc, marker=str(m),
+                         acked_seq=acked[m], durable_seq=seq)
+        lost = sorted(set(acked) - set(markers))
+        if lost:
+            _violate("lost_acked_op", doc=doc, marker=lost[0],
+                     n_lost=len(lost))
+        for sess in residents:
+            mine = [m for m in markers
+                    if m.startswith(sess.key + "#")]
+            if mine != sess.submitted:
+                _violate("order_divergence", doc=doc, session=sess.key,
+                         durable=len(mine),
+                         expected=len(sess.submitted))
+
+
+def _report(seed, storm_s, tenants, everyone, adm, policy,
+            storm_offered, storm_acked, recent_lat, churns, bursts,
+            slo_ms, goodput_min, policy_trace) -> dict:
+    snap = adm.snapshot()
+    compliant = [t for t in tenants
+                 if t.role == "writer" and t.load <= 1.0]
+    abusive = [t for t in tenants
+               if t.role == "writer" and t.load > 1.0]
+    offered = sum(len(s.submitted) for s in everyone)
+    acked = sum(len(s.conn.op_acks) for s in everyone)
+    c_off = sum(storm_offered[t.name] for t in compliant)
+    c_ack = sum(storm_acked[t.name] for t in compliant)
+    lat = [v for s in everyone for v in s.admitted_latencies_ms()]
+    capacity = sum(t.rate for t in tenants if t.role == "writer")
+    report = {
+        "seed": seed,
+        "storm_s": round(storm_s, 3),
+        "capacity_ops_s": capacity,
+        "offered_ops_s": round(offered / storm_s, 1),
+        "ops_offered": offered,
+        "ops_acked": acked,
+        "silent_drops": offered - acked,
+        "goodput_ratio": round(c_ack / c_off, 4) if c_off else 1.0,
+        "admitted_ack_p99_ms": round(_p99(lat), 3),
+        "slo_ms": slo_ms,
+        "throttled_frames": sum(s.conn.throttled for s in everyone),
+        "throttle_resubmits": sum(s.conn.throttle_resubmits
+                                  for s in everyone),
+        "shed_total": snap["shed_total"],
+        "shed_ratio": round(snap["shed_total"]
+                            / max(1, offered), 4),
+        "abusive_throttled": sum(s.conn.throttled for s in everyone
+                                 if s.spec.load > 1.0),
+        "abusive_shed": sum(snap["tenants"].get(t.name, {})
+                            .get("shed", 0) for t in abusive),
+        "reader_ops_observed": sum(s.ops_observed for s in everyone
+                                   if s.spec.role == "reader"),
+        "session_churns": churns,
+        "arrival_bursts": bursts,
+        "sessions": len(everyone),
+        "policy": {
+            "ticks": policy.ticks,
+            "breach_ticks": policy.breach_ticks,
+            "min_scale": round(policy.min_scale_seen, 4),
+            "max_shed_probability": round(policy.max_shed_seen, 4),
+            "final": policy_trace[-1] if policy_trace else None,
+        },
+        "tenants": {
+            t.name: {
+                "role": t.role, "budget_ops_s": t.rate,
+                "load": t.load,
+                "offered_storm": storm_offered[t.name],
+                "acked_storm": storm_acked[t.name],
+                **snap["tenants"].get(t.name, {}),
+            } for t in tenants
+        },
+        "violations": 0,
+    }
+    gates = _Gates(goodput_min=goodput_min, slo_ms=slo_ms)
+    gates.expect(report["silent_drops"] == 0, "silent_drops != 0")
+    gates.expect(report["goodput_ratio"] >= goodput_min,
+                 f"goodput {report['goodput_ratio']} < {goodput_min}")
+    gates.expect(report["admitted_ack_p99_ms"] <= slo_ms,
+                 f"admitted ack p99 {report['admitted_ack_p99_ms']}ms "
+                 f"> {slo_ms}ms")
+    if abusive:
+        gates.expect(report["abusive_throttled"] > 0,
+                     "abusive tenant never saw a throttled frame")
+        gates.expect(report["abusive_shed"] > 0,
+                     "controller ledger shows no abusive shed")
+    report["gate_failures"] = gates.failures
+    return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="multi-tenant overload sim (see module docstring)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=6.0)
+    ap.add_argument("--docs", type=int, default=8)
+    ap.add_argument("--slo-ms", type=float, default=200.0)
+    ap.add_argument("--goodput-min", type=float, default=0.8)
+    ap.add_argument("--quick", action="store_true",
+                    help="tier-1 profile: ~2s storm, lenient SLO for "
+                         "one-core CI")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless every acceptance gate passes")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.duration = min(args.duration, 1.6)
+        args.slo_ms = max(args.slo_ms, 250.0)
+    report = run_sim(seed=args.seed, duration_s=args.duration,
+                     n_docs=args.docs, slo_ms=args.slo_ms,
+                     goodput_min=args.goodput_min, quick=args.quick)
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if args.check and report["gate_failures"]:
+        print(f"GATE FAILURES: {report['gate_failures']}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
