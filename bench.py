@@ -1093,7 +1093,7 @@ def run():
     )
 
     def _ingress_trial(n_clients=8, docs_per=1024, waves=24,
-                       window_rows=4096):
+                       window_rows=4096, with_ops=False):
         ing_eng = StringServingEngine(
             n_docs=n_clients * docs_per, capacity=256,
             batch_window=10 ** 9, compact_every=10 ** 9,
@@ -1101,6 +1101,25 @@ def run():
         srv = ColumnarAlfred(ing_eng, window_min_rows=window_rows,
                              window_ms=2.0,
                              pipeline_depth=3).start_in_thread()
+        # scrape-overhead acceptance (ISSUE 17): attach the live ops
+        # plane and hit /metrics at 1 Hz for the whole storm — the
+        # scraped trial's rate vs the unscraped median is the overhead
+        ops = None
+        scrape_stop = threading.Event()
+        scrapes = [0]
+        if with_ops:
+            import urllib.request as _url
+            ops = srv.start_ops(tick_interval_s=1.0)
+
+            def _scraper():
+                while not scrape_stop.is_set():
+                    with _url.urlopen(ops.url + "/metrics",
+                                      timeout=30) as r:
+                        r.read()
+                    scrapes[0] += 1
+                    scrape_stop.wait(1.0)
+
+            threading.Thread(target=_scraper, daemon=True).start()
         total = n_clients * docs_per * waves
         acked = [0] * n_clients
         done = threading.Barrier(n_clients + 1)
@@ -1141,20 +1160,62 @@ def run():
         pstats = srv.pipeline_stats()
         dstats = srv.drain_stats()
         windows = srv.windows_flushed
+        opsinfo = None
+        if with_ops:
+            import json as _json
+            import urllib.request as _url
+            scrape_stop.set()
+            with _url.urlopen(ops.url + "/debug/latency",
+                              timeout=30) as r:
+                breakdown = _json.loads(r.read())
+            opsinfo = {"scrapes": scrapes[0], "breakdown": breakdown}
         srv.stop()
         del ing_eng
-        return rate, pstats, dstats, windows
+        return rate, pstats, dstats, windows, opsinfo
 
     ingress_trials, ingress_stats, ingress_windows = [], None, 0
     ingress_drain = None
     for _t in range(3):
-        rate, pstats, dstats, windows = _ingress_trial()
+        rate, pstats, dstats, windows, _ = _ingress_trial()
         ingress_trials.append(rate)
         if rate >= max(ingress_trials):
             ingress_stats, ingress_windows = pstats, windows
             ingress_drain = dstats
     ingress_trials.sort()
     columnar_ingress_ops_per_sec = ingress_trials[-1]
+    # three more storms with the ops endpoint attached and scraped at
+    # 1 Hz (ISSUE 17 acceptance: < 1% throughput loss vs unscraped, and
+    # the per-stage breakdown sums to the observed e2e ack latency).
+    # Median-of-3 vs median-of-3: single-trial spread on a contended
+    # host is ±5-7%, far above the real scrape cost — one draw against
+    # the unscraped median reads noise as overhead.
+    scraped_trials, opsinfo = [], None
+    for _t in range(3):
+        s_rate, _, _, _, s_info = _ingress_trial(with_ops=True)
+        scraped_trials.append(s_rate)
+        if s_rate >= max(scraped_trials):
+            opsinfo = s_info
+    scraped_trials.sort()
+    scraped_rate = scraped_trials[len(scraped_trials) // 2]
+    _unscraped = ingress_trials[len(ingress_trials) // 2]
+    _bd = opsinfo["breakdown"]
+    ops_plane = {
+        "scraped_ops_per_sec": round(scraped_rate, 1),
+        "scraped_trials": [round(t, 1) for t in scraped_trials],
+        "unscraped_median_ops_per_sec": round(_unscraped, 1),
+        "scrape_overhead_pct": round(
+            (_unscraped - scraped_rate) / _unscraped * 100.0, 2),
+        "scrapes": opsinfo["scrapes"],
+        "stage_breakdown_coverage": round(_bd["coverage"], 4),
+        "stage_e2e_mean_ms": round(_bd["e2e_mean_ms"], 3),
+        # p99 is None when it fell off the histogram grid (the route's
+        # JSON hygiene maps inf -> null); keep the record strict-JSON
+        "stage_e2e_p99_ms": round(_bd["e2e_p99_ms"], 3)
+        if _bd["e2e_p99_ms"] is not None else None,
+        "stage_shares": {name: round(row["share"], 4)
+                         for name, row in _bd["stages"].items()},
+        "windows_attributed": _bd["windows"],
+    }
     rtt_phases["after_ingress"] = round(rtt_now(), 1)
 
     _phase("small-window ack")
@@ -1683,6 +1744,11 @@ def run():
             ingress_drain["bytes_per_pass_p50"],
         "ingress_drain_passes": ingress_drain["passes"],
         "ingress_decode_tier": ingress_drain["tier"],
+        # live operations plane (ISSUE 17): scrape overhead of the 1 Hz
+        # /metrics poller against the columnar storm, plus the stage
+        # attribution's coverage (stage sum / e2e ack — 1.0 = the
+        # breakdown fully explains the observed latency)
+        "ops_plane": ops_plane,
         # resilience under load (ISSUE 9): the seeded reconnect storm's
         # throughput/latency plus the invariant-violation count the
         # perf sentinel gates on

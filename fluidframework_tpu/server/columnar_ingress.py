@@ -69,6 +69,7 @@ from ..utils.backoff import Backoff, retry
 from ..utils.telemetry import REGISTRY
 from . import native_ingress
 from .ingest_pipeline import PipelinedIngestExecutor
+from .opsd import SpaceSaving, observe_window_timeline
 from .wire import BufferedSocketReader
 
 _HDR = struct.Struct("<BI")
@@ -290,6 +291,9 @@ class _ColSession:
         self.evicted = False
         self.dead = False
         self.rx = bytearray()
+        #: perf_counter of the first undrained byte — the rx-buffer
+        #: crossing of the latency-attribution timeline (ISSUE 17)
+        self.rx_t0: Optional[float] = None
         #: cleared while the rx buffer is over budget — reader
         #: backpressure until a drain trims it
         self._resume = asyncio.Event()
@@ -496,6 +500,15 @@ class ColumnarAlfred:
         self._waves_inflight = 0
         self._capacity: Optional[asyncio.Event] = None
         self._pipeline_error: Optional[BaseException] = None
+        #: heavy-hitter sketch over (doc, tenant), fed by the drain pass
+        #: (ISSUE 17) — the hot-doc routing/eviction signal
+        self.hotdocs = SpaceSaving(capacity=256)
+        #: latency-attribution timeline of the current drain pass:
+        #: rx/drain/decode/admit crossings every window of the pass
+        #: inherits (the executor marks + ack fan complete it)
+        self._pass_tl: Optional[dict] = None
+        self._pass_admit_ms = 0.0
+        self._ops: Optional[object] = None   # attached OpsServer
 
     # ------------------------------------------------------------ ingest side
 
@@ -503,6 +516,8 @@ class ColumnarAlfred:
         """Reader hook: bytes landed on a session. Wake the flusher once
         roughly a window's worth of records is waiting; smaller dribbles
         ride the ``window_ms`` tick (the old enqueue path's pacing)."""
+        if sess.rx_t0 is None:
+            sess.rx_t0 = time.perf_counter()
         self._dirty[sess] = None
         self._rx_backlog += n
         if self._rx_backlog >= self._wake_bytes and self._wake is not None:
@@ -539,13 +554,26 @@ class ColumnarAlfred:
         sessions = list(self._dirty)
         self._dirty.clear()
         self._rx_backlog = 0
+        self._pass_admit_ms = 0.0
         total = 0
+        rx_min: Optional[float] = None
         for sess in sessions:
             if sess.dead or not sess.rx:
                 continue
+            if sess.rx_t0 is not None and (rx_min is None
+                                           or sess.rx_t0 < rx_min):
+                rx_min = sess.rx_t0
             total += self._drain_session(sess)
         if total:
-            self._drain_ms.append((time.perf_counter() - t0) * 1e3)
+            t1 = time.perf_counter()
+            # pass-level timeline crossings: every window carved from
+            # this pass inherits them (t_rx = oldest undrained byte —
+            # the worst op's wait, which is what an SLO cares about)
+            self._pass_tl = {"t_rx": rx_min if rx_min is not None else t0,
+                             "t_drain0": t0,
+                             "admit_ms": self._pass_admit_ms,
+                             "t_ready": t1}
+            self._drain_ms.append((t1 - t0) * 1e3)
             self._drain_bytes.append(total)
             self.drain_passes += 1
             self.drained_bytes += total
@@ -606,8 +634,13 @@ class ColumnarAlfred:
         if fatal is not None or bye:
             sess._fatal(fatal)
             rx.clear()
+            sess.rx_t0 = None
         else:
             del rx[:consumed]
+            # leftover bytes are a torn frame whose tail hasn't arrived:
+            # restart its rx clock at the drain (the op isn't waiting on
+            # us yet — it is still in flight on the wire)
+            sess.rx_t0 = time.perf_counter() if rx else None
             if not sess._resume.is_set() \
                     and len(rx) < self.max_rx_bytes:
                 sess._resume.set()
@@ -680,10 +713,13 @@ class ColumnarAlfred:
             gidx, cseq, ref, client = (x[ok] for x in
                                        (gidx, cseq, ref, client))
         if row.size and self.admission is not None:
+            _t_adm = time.perf_counter()
             row, kind, a0, a1, gidx, cseq, ref, client = \
                 self._admit_planes(sess, row, kind, a0, a1, gidx,
                                    cseq, ref, client)
+            self._pass_admit_ms += (time.perf_counter() - _t_adm) * 1e3
         if row.size:
+            self._note_hotdocs(row, int(client[0]))
             self._parts.append({"sess": sess, "row": row, "kind": kind,
                                 "a0": a0, "a1": a1, "gidx": gidx,
                                 "cseq": cseq, "ref": ref,
@@ -772,6 +808,23 @@ class ColumnarAlfred:
                                        (gidx, cseq, ref, client))
         return row, kind, a0, a1, gidx, cseq, ref, client
 
+    def _note_hotdocs(self, row: np.ndarray, cid: int) -> None:
+        """Feed the heavy-hitter sketch from one session's admitted
+        planes: one ``offer`` per unique (doc, tenant) in the part, not
+        per op — O(unique rows) per drain, bounded memory overall."""
+        if self.admission is not None:
+            tenant = self.admission.tenant_of(cid)
+        else:
+            tenant = f"client-{cid}"
+        docs = getattr(self.engine, "_row_doc_id", None)
+        u, counts = np.unique(row, return_counts=True)
+        for r, n in zip(u.tolist(), counts.tolist()):
+            doc = None
+            if docs is not None and r < len(docs):
+                doc = docs[r]
+            self.hotdocs.offer((doc if doc is not None else f"row-{r}",
+                                tenant), n)
+
     def _build_windows(self) -> List[dict]:
         """Carve the pass's decoded backlog into unique-row windows:
         stable sort by row, split by per-row occurrence level (level k =
@@ -843,7 +896,7 @@ class ColumnarAlfred:
                 "client": f["client"][w].reshape(-1, 1),
                 "cseq_flat": f["cseq"][w], "sessi": sessi[w],
                 "texts": texts_w or [""], "props": props_w or None,
-                "tab": tab})
+                "tab": tab, "tl": self._pass_tl})
         # the interners only feed this pass's windows, which now carry
         # their own compacted tables — reset so they stay bounded
         self._texts, self._text_of = [], {}
@@ -859,6 +912,9 @@ class ColumnarAlfred:
             # only after the durable append commits (ack-after-durable)
             with tracing.TRACER.maybe_root_span(
                     "columnar.submit_window", every=256, ops=n):
+                # sampled windows carry their trace context to the ack
+                # fan: the e2e histogram's exemplar names a real trace
+                w["ctx"] = tracing.TRACER.current()
                 ticket = self._executor.submit(
                     w["rows"], w["client"], w["cseq"], w["ref"],
                     w["kind"], w["a0"], w["a1"], texts=w["texts"],
@@ -871,18 +927,21 @@ class ColumnarAlfred:
         else:
             with tracing.TRACER.maybe_root_span(
                     "columnar.flush_window", every=256, ops=n):
+                w["ctx"] = tracing.TRACER.current()
                 res = self.engine.ingest_planes(
                     w["rows"], w["client"], w["cseq"], w["ref"],
                     w["kind"], w["a0"], w["a1"], texts=w["texts"],
                     tidx=w["tidx"], props=w["props"])
-            self._fan_acks(w, np.asarray(res["seq"]).reshape(-1))
+            self._fan_acks(w, np.asarray(res["seq"]).reshape(-1),
+                           marks=res.get("marks"))
         self.windows_flushed += 1
         self.ops_ingested += n
         self._pending_ops -= n
         REGISTRY.inc("columnar_windows_flushed")
         REGISTRY.inc("columnar_ops_ingested", n)
 
-    def _fan_acks(self, w: dict, seqs: np.ndarray) -> None:
+    def _fan_acks(self, w: dict, seqs: np.ndarray,
+                  marks: Optional[dict] = None) -> None:
         """Fan a window's acks back, one frame per participating session.
 
         Runs AFTER the durable append (serial path: ingest_planes
@@ -911,6 +970,12 @@ class ColumnarAlfred:
             tab[int(sessi[g[0]])]._push_json(
                 {"t": "acks", "acks": pairs.tolist(),
                  "rows": rows[g].tolist()})
+        # latency attribution (ISSUE 17): the ack fan completes the
+        # window's timeline — attribute e2e to consecutive stage segments
+        tl = w.get("tl")
+        if tl is not None and marks:
+            observe_window_timeline(tl, marks, time.perf_counter(),
+                                    exemplar=w.get("ctx"))
 
     def _bounce_ack(self, loop, ticket, w: dict) -> None:
         """Ticket done-callback: runs on the executor's log worker —
@@ -934,7 +999,9 @@ class ColumnarAlfred:
             if self._wake is not None:
                 self._wake.set()
             return
-        self._fan_acks(w, np.asarray(ticket.result()["seq"]).reshape(-1))
+        res = ticket.result()
+        self._fan_acks(w, np.asarray(res["seq"]).reshape(-1),
+                       marks=res.get("marks"))
 
     async def _wait_capacity(self) -> None:
         """Depth backpressure: park the flusher (event loop stays free to
@@ -1014,7 +1081,23 @@ class ColumnarAlfred:
             raise TimeoutError("columnar ingress failed to start")
         return self
 
+    def start_ops(self, host: str = "127.0.0.1", port: int = 0,
+                  **kw) -> "object":
+        """Attach a live operations plane (``server.opsd.OpsServer``) to
+        this door: scrape ``/metrics`` at 1 Hz, read ``/debug/hotdocs``
+        from the drain-pass sketch, ``/debug/latency`` from the stage
+        attribution. Stopped automatically by :meth:`stop`."""
+        from .opsd import OpsServer
+        ops = OpsServer(host=host, port=port, **kw)
+        ops.add_hotdocs(self.hotdocs)
+        self._ops = ops.start()
+        return ops
+
     def stop(self) -> None:
+        ops = self._ops
+        if ops is not None:
+            self._ops = None
+            ops.stop()
         ex = self._executor
         if ex is not None:
             # drain first: in-flight waves resolve (acks fan while the

@@ -66,11 +66,15 @@ class IngestTicket:
     thread (front doors bounce acks back to their event loop)."""
 
     __slots__ = ("index", "_event", "_result", "_error", "_callbacks",
-                 "_lock", "_dispatched", "wave")
+                 "_lock", "_dispatched", "wave", "t_submit")
 
     def __init__(self, index: int):
         self.index = index
         self.wave = None
+        #: submit-time crossing: the executor observes submit→durable
+        #: wall per wave (``ingest_ticket_wall_ms``) — queue waits
+        #: included, unlike the per-stage busy times
+        self.t_submit = time.perf_counter()
         self._event = threading.Event()
         self._dispatched = threading.Event()
         self._result: Optional[dict] = None
@@ -351,6 +355,8 @@ class PipelinedIngestExecutor:
                 eng.metrics.observe("ingest_wave_wall_ms",
                                     (now - self._last_done) * 1000)
             self._last_done = now
+            eng.metrics.observe("ingest_ticket_wall_ms",
+                                (now - ticket.t_submit) * 1000)
             eng.metrics.inc("ingest_waves")
             self._finish(ticket, result=result)
 

@@ -209,6 +209,10 @@ class _Session:
                                 "retry_after_ms": retry})
                     return True
             REGISTRY.inc("ingress_ops")
+            tenant = (adm.tenant_of(self.conn.client_id)
+                      if adm is not None
+                      else f"client-{self.conn.client_id}")
+            self.server.hotdocs.offer((self.conn.doc_id, tenant))
             # the frame carried the client's wire-span context across the
             # socket: re-attach so the synchronous pipeline (deli → apply
             # → broadcast) parents under the client's trace
@@ -331,6 +335,11 @@ class AlfredServer:
         self.admission = admission
         self.evictions = 0  # slow-client disconnects (observability)
         self._server: Optional[asyncio.AbstractServer] = None
+        #: heavy-hitter sketch over (doc, tenant) — same introspection
+        #: signal as the columnar door's, fed per admitted op (ISSUE 17)
+        from .opsd import SpaceSaving
+        self.hotdocs = SpaceSaving(capacity=256)
+        self._ops = None
 
     async def start(self, bind_attempts: int = 5,
                     base_delay: float = 0.05) -> None:
@@ -388,7 +397,21 @@ class AlfredServer:
             raise TimeoutError("ingress server failed to start")
         return self
 
+    def start_ops(self, host: str = "127.0.0.1", port: int = 0, **kw):
+        """Attach a live operations plane (``server.opsd.OpsServer``) to
+        this door; its hot-doc sketch is served at ``/debug/hotdocs``.
+        Stopped automatically by :meth:`stop`."""
+        from .opsd import OpsServer
+        ops = OpsServer(host=host, port=port, **kw)
+        ops.add_hotdocs(self.hotdocs)
+        self._ops = ops.start()
+        return ops
+
     def stop(self) -> None:
+        ops = self._ops
+        if ops is not None:
+            self._ops = None
+            ops.stop()
         loop = getattr(self, "_loop", None)
         if loop is not None:
             loop.call_soon_threadsafe(

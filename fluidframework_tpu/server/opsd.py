@@ -1,0 +1,402 @@
+"""Live operations plane (ISSUE 17): scrape endpoint, latency
+attribution, hot-doc introspection.
+
+Until now every observability surface was post-hoc — ``full_snapshot()``
+embedded in bench records, ``TimeSeriesStore`` ticked only inside
+bench.py, ``tools/healthz.py`` reading JSONL exports after the run. This
+module makes a *running* server observable:
+
+* :class:`OpsServer` — a threaded HTTP façade (``utils.ops_http``) over
+  the process singletons: ``/metrics`` (Prometheus text exposition with
+  correct content-type and label escaping), ``/healthz`` (live SLO
+  scorecard JSON), ``/debug/flights`` (flight-recorder ring),
+  ``/debug/trace`` (recent spans as Chrome trace-event JSON),
+  ``/debug/hotdocs`` (heavy-hitter sketch), ``/debug/latency``
+  (per-stage breakdown). A background ticker thread finally runs
+  ``TimeSeriesStore`` sampling + ``SLOEngine`` burn checks on live
+  servers, the role the reference's Prometheus scrape loop plays behind
+  Routerlicious.
+
+* Latency attribution — :func:`observe_window_timeline` turns the
+  monotonic crossing stamps the ingress door and the ingest executor
+  record onto each window (rx-buffer → drain/decode → admission → pack →
+  sequence → dispatch → durable-append → ack) into per-stage
+  ``stage_*_ms`` histograms. Stages are *consecutive timeline segments*,
+  so they sum to the observed end-to-end ack latency by construction —
+  :func:`latency_breakdown` is the "which stage do we shard next" view.
+
+* :class:`SpaceSaving` — the bounded heavy-hitter sketch over
+  ``(doc, tenant)`` maintained in the drain pass; ``/debug/hotdocs`` and
+  the ``hotdoc_*`` gauges expose the routing/eviction signal ROADMAP
+  items 1 and 3 consume.
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import flight_recorder as _flight
+from ..utils import slo as _slo
+from ..utils import tracing as _tracing
+from ..utils.ops_http import OpsHTTPServer, json_body
+from ..utils.telemetry import (PROM_CONTENT_TYPE, REGISTRY,
+                               MetricsRegistry)
+from ..utils.timeseries import TimeSeriesStore
+
+__all__ = ["OpsServer", "SpaceSaving", "STAGES",
+           "observe_window_timeline", "latency_breakdown"]
+
+
+# --------------------------------------------------------------------------
+# latency attribution
+# --------------------------------------------------------------------------
+
+#: canonical stage order of the ingest path; ``stage_{name}_ms``
+#: histograms are consecutive segments of one monotonic timeline
+STAGES = ("rx", "decode", "admit", "pack",
+          "sequence", "dispatch", "log", "ack")
+
+
+def observe_window_timeline(tl: dict, marks: dict, t_ack: float,
+                            registry: Optional[MetricsRegistry] = None,
+                            exemplar: Any = None) -> None:
+    """Attribute one window's end-to-end ack latency to stages.
+
+    ``tl`` is the front-door timeline the drain pass stamps
+    (``t_rx``/``t_drain0``/``decode_ms``/``admit_ms``/``t_ready``),
+    ``marks`` the executor-side crossings the engine's stage methods
+    stamp (``pack1``/``seq1``/``disp1``/``log1``, absolute
+    ``perf_counter`` seconds), ``t_ack`` the ack-fan time. Segment k is
+    ``crossing[k+1] - crossing[k]`` with crossings clamped monotonic, so
+    ``sum(stage_*_ms) == stage_e2e_ack_ms`` exactly — queue waits land
+    in the stage that absorbed them (pack's segment includes the
+    executor hand-off wait; ack's the done-callback bounce)."""
+    t_rx = float(tl["t_rx"])
+    t_ready = float(tl["t_ready"])
+    admit_s = max(0.0, float(tl.get("admit_ms", 0.0))) * 1e-3
+    crossings = [
+        t_rx,
+        float(tl["t_drain0"]),      # rx segment ends: drain pass starts
+        t_ready - admit_s,          # decode ends where admission begins
+        t_ready,                    # decoded + admitted, awaiting submit
+        float(marks.get("pack1", t_ready)),
+        float(marks.get("seq1", t_ready)),
+        float(marks.get("disp1", t_ready)),
+        float(marks.get("log1", t_ready)),
+        float(t_ack),
+    ]
+    for i in range(1, len(crossings)):   # clock skew / missing marks
+        if crossings[i] < crossings[i - 1]:
+            crossings[i] = crossings[i - 1]
+    reg = registry if registry is not None else REGISTRY
+    for name, a, b in zip(STAGES, crossings, crossings[1:]):
+        reg.observe(f"stage_{name}_ms", (b - a) * 1e3)
+    reg.observe("stage_e2e_ack_ms", (crossings[-1] - crossings[0]) * 1e3,
+                exemplar=exemplar)
+
+
+def latency_breakdown(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Per-stage summary of the accumulated attribution histograms.
+
+    ``stage_sum_ms`` (the sum of per-stage means) matches ``e2e_mean_ms``
+    within clock-granularity tolerance whenever every observed window
+    recorded all stages — the acceptance check for ISSUE 17 and the
+    sharding signal: the stage with the largest mean share is the next
+    thing to scale out."""
+    reg = registry if registry is not None else REGISTRY
+    stages: Dict[str, dict] = {}
+    stage_sum = 0.0
+    for name in STAGES:
+        h = reg.histograms.get(f"stage_{name}_ms")
+        if h is None or h.n == 0:
+            continue
+        stages[name] = {"mean_ms": h.mean, "p50_ms": h.percentile(50),
+                        "p99_ms": h.percentile(99), "count": h.n}
+        stage_sum += h.mean
+    e2e = reg.histograms.get("stage_e2e_ack_ms")
+    e2e_mean = e2e.mean if e2e is not None and e2e.n else 0.0
+    for name, row in stages.items():
+        row["share"] = row["mean_ms"] / e2e_mean if e2e_mean else 0.0
+    return {
+        "stages": stages,
+        "stage_sum_ms": stage_sum,
+        "e2e_mean_ms": e2e_mean,
+        "e2e_p99_ms": e2e.percentile(99) if e2e is not None else 0.0,
+        "windows": e2e.n if e2e is not None else 0,
+        "coverage": stage_sum / e2e_mean if e2e_mean else 0.0,
+    }
+
+
+# --------------------------------------------------------------------------
+# heavy-hitter sketch
+# --------------------------------------------------------------------------
+
+class SpaceSaving:
+    """Bounded Space-Saving heavy-hitter sketch (Metwally et al. 2005).
+
+    Tracks at most ``capacity`` keys in O(capacity) memory. Estimated
+    counts overestimate the true count by at most the entry's ``err``
+    (the evicted minimum it inherited), and any key whose true count
+    exceeds ``total / capacity`` is guaranteed to be tracked — exactly
+    the guarantee a hot-doc router or eviction policy needs. Thread-safe:
+    the drain pass offers from the ingress loop, the ops endpoint reads
+    from scrape threads."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(1, int(capacity))
+        #: key -> [count, err]
+        self._entries: Dict[Any, List[int]] = {}
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def offer(self, key: Any, n: int = 1) -> None:
+        with self._lock:
+            self.total += n
+            e = self._entries.get(key)
+            if e is not None:
+                e[0] += n
+                return
+            if len(self._entries) < self.capacity:
+                self._entries[key] = [n, 0]
+                return
+            # evict the current minimum; the newcomer inherits its count
+            # as the overestimation bound
+            victim = min(self._entries, key=lambda k: self._entries[k][0])
+            floor = self._entries.pop(victim)[0]
+            self._entries[key] = [floor + n, floor]
+
+    def top(self, k: int = 10) -> List[Tuple[Any, int, int]]:
+        """``(key, estimated_count, err)`` rows, largest first.
+        ``estimated_count - err`` is a guaranteed lower bound."""
+        with self._lock:
+            rows = sorted(self._entries.items(),
+                          key=lambda kv: kv[1][0], reverse=True)
+        return [(key, e[0], e[1]) for key, e in rows[:k]]
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.total = 0
+
+
+def publish_hotdoc_gauges(sketches: List[SpaceSaving],
+                          registry: Optional[MetricsRegistry] = None
+                          ) -> None:
+    """Roll the attached sketches up into the ``hotdoc_*`` gauges: how
+    many keys are tracked, the hottest key's estimated ops, and its
+    share of all sketched traffic — the skew signal at a glance."""
+    reg = registry if registry is not None else REGISTRY
+    tracked = sum(len(s) for s in sketches)
+    total = sum(s.total for s in sketches)
+    top = 0
+    for s in sketches:
+        rows = s.top(1)
+        if rows:
+            top = max(top, rows[0][1])
+    reg.set_gauge("hotdoc_tracked", float(tracked))
+    reg.set_gauge("hotdoc_top_count", float(top))
+    reg.set_gauge("hotdoc_top_share", top / total if total else 0.0)
+
+
+# --------------------------------------------------------------------------
+# JSON hygiene
+# --------------------------------------------------------------------------
+
+def _finite(obj: Any) -> Any:
+    """Recursively replace non-finite floats with ``None`` so route
+    payloads stay strict JSON (scorecard burn rates are ``inf`` when a
+    window has no samples; histogram percentiles can be ``inf``)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _finite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finite(v) for v in obj]
+    return obj
+
+
+# --------------------------------------------------------------------------
+# the ops server
+# --------------------------------------------------------------------------
+
+class OpsServer:
+    """The live operations plane of one process.
+
+    Attach it to anything that serves: ``LocalService.start_ops()``,
+    ``ColumnarAlfred.start_ops()``, ``AlfredServer.start_ops()``, or the
+    tools' ``--ops-port``. It owns (or borrows) a ``TimeSeriesStore`` +
+    ``SLOEngine`` pair and a background ticker thread so sampling and
+    burn-rate checks run continuously — ``tick_interval_s=0`` disables
+    the ticker for hosts that already tick their own control loop
+    (tenant_sim)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[MetricsRegistry] = None,
+                 store: Optional[TimeSeriesStore] = None,
+                 slo_engine: Optional[Any] = None,
+                 specs: Optional[list] = None,
+                 recorder: Optional[Any] = None,
+                 tracer: Optional[Any] = None,
+                 tick_interval_s: float = 1.0):
+        self.registry = registry if registry is not None else REGISTRY
+        self.store = store if store is not None \
+            else TimeSeriesStore(registry=self.registry)
+        if slo_engine is not None:
+            self.slo_engine = slo_engine
+        else:
+            self.slo_engine = _slo.SLOEngine(
+                self.store, specs=specs if specs is not None
+                else _slo.default_slos(), registry=self.registry)
+        self.recorder = recorder if recorder is not None \
+            else _flight.RECORDER
+        self.tracer = tracer if tracer is not None else _tracing.TRACER
+        self.tick_interval_s = tick_interval_s
+        self.ticks = 0
+        self._t_started = time.time()
+        self._sketches: List[SpaceSaving] = []
+        self._on_tick: List[Callable[[], None]] = []
+        self._tick_stop = threading.Event()
+        self._ticker: Optional[threading.Thread] = None
+        self.http = (OpsHTTPServer(host, port)
+                     .route("/metrics", self._r_metrics)
+                     .route("/healthz", self._r_healthz)
+                     .route("/debug/flights", self._r_flights)
+                     .route("/debug/trace", self._r_trace)
+                     .route("/debug/hotdocs", self._r_hotdocs)
+                     .route("/debug/latency", self._r_latency))
+
+    # -------------------------------------------------------- attachments
+
+    def add_hotdocs(self, sketch: SpaceSaving) -> "OpsServer":
+        """Expose a drain-pass sketch at ``/debug/hotdocs`` and in the
+        ``hotdoc_*`` gauges (multiple doors may each attach one)."""
+        self._sketches.append(sketch)
+        return self
+
+    def on_tick(self, fn: Callable[[], None]) -> "OpsServer":
+        """Run ``fn()`` on every ticker beat (host gauge publishers —
+        e.g. a service exporting replica queue depth). Exceptions are
+        swallowed: a bad publisher must not kill sampling."""
+        self._on_tick.append(fn)
+        return self
+
+    # ------------------------------------------------------------- routes
+
+    def _r_metrics(self, _q: Dict[str, str]) -> Tuple[str, bytes]:
+        self.registry.inc("ops_scrapes_total")
+        text = self.registry.render_prometheus()
+        return (PROM_CONTENT_TYPE, text.encode("utf-8"))
+
+    def _r_healthz(self, _q: Dict[str, str]) -> Tuple[str, bytes]:
+        rows = self.slo_engine.scorecard()
+        judged = [r for r in rows if r.get("judged")]
+        return json_body(_finite({
+            "ok": all(r["ok"] for r in judged),
+            "judged": len(judged),
+            "ticks": self.ticks,
+            "uptime_s": time.time() - self._t_started,
+            "rows": rows,
+        }))
+
+    def _r_flights(self, q: Dict[str, str]) -> Tuple[str, bytes]:
+        limit = int(q.get("n", "512"))
+        events = self.recorder.snapshot()
+        return json_body(_finite({
+            "count": len(events),
+            "suppressed": dict(self.recorder.suppressed),
+            "events": events[-limit:],
+        }))
+
+    def _r_trace(self, q: Dict[str, str]) -> Tuple[str, bytes]:
+        if q.get("list"):
+            return json_body({"trace_ids": self.tracer.trace_ids()})
+        limit = int(q.get("n", "2048"))
+        events = self.tracer.events(q.get("trace"))[-limit:]
+        return json_body(_finite(
+            {"traceEvents": [_tracing.chrome_event(e) for e in events]}))
+
+    def _r_hotdocs(self, q: Dict[str, str]) -> Tuple[str, bytes]:
+        k = int(q.get("k", "20"))
+        merged: List[Tuple[Any, int, int]] = []
+        for s in self._sketches:
+            merged.extend(s.top(k))
+        merged.sort(key=lambda row: row[1], reverse=True)
+        return json_body(_finite({
+            "capacity": sum(s.capacity for s in self._sketches),
+            "tracked": sum(len(s) for s in self._sketches),
+            "total_ops": sum(s.total for s in self._sketches),
+            "top": [{"doc": key[0], "tenant": key[1],
+                     "count": count, "err": err}
+                    if isinstance(key, tuple) and len(key) == 2 else
+                    {"key": key, "count": count, "err": err}
+                    for key, count, err in merged[:k]],
+        }))
+
+    def _r_latency(self, _q: Dict[str, str]) -> Tuple[str, bytes]:
+        return json_body(_finite(latency_breakdown(self.registry)))
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "OpsServer":
+        self.http.start()
+        if self.tick_interval_s and self._ticker is None:
+            self._tick_stop.clear()
+            self._ticker = threading.Thread(
+                target=self._tick_loop, name="opsd-ticker", daemon=True)
+            self._ticker.start()
+        return self
+
+    def stop(self) -> None:
+        self._tick_stop.set()
+        ticker = self._ticker
+        self._ticker = None
+        if ticker is not None:
+            ticker.join(timeout=5)
+        self.http.stop()
+
+    @property
+    def port(self) -> int:
+        return self.http.port
+
+    @property
+    def url(self) -> str:
+        return self.http.url
+
+    def __enter__(self) -> "OpsServer":
+        return self.start()
+
+    def __exit__(self, *_exc) -> None:
+        self.stop()
+
+    # -------------------------------------------------------------- ticker
+
+    def tick_once(self, now: Optional[float] = None) -> None:
+        """One sampling beat: time-series sample, SLO burn check, hot-doc
+        gauges, host publishers. The ticker thread calls this; hosts
+        with their own control loop may call it directly."""
+        self.ticks += 1
+        self.registry.inc("ops_ticks_total")
+        self.registry.set_gauge("ops_ticker_last_unix", time.time())
+        self.registry.set_gauge("ops_uptime_s",
+                                time.time() - self._t_started)
+        if self._sketches:
+            publish_hotdoc_gauges(self._sketches, self.registry)
+        for fn in list(self._on_tick):
+            try:
+                fn()
+            except Exception:
+                pass
+        self.store.tick(now=now)
+        try:
+            self.slo_engine.check(now=now)
+        except Exception:
+            pass
+
+    def _tick_loop(self) -> None:
+        while not self._tick_stop.wait(self.tick_interval_s):
+            self.tick_once()

@@ -1072,7 +1072,8 @@ class _IngestWave:
         "flat_client_seq", "flat_ref_seq", "handles", "prepacked",
         "pipelined", "prep_ms", "seq_ms", "out_seq", "out_min", "nacked",
         "n_ok", "kind_eff", "seq_rs", "seq_base", "n_valid", "min_rs",
-        "compact_due", "ms_arr", "apply_stats", "ov_prev", "dup_acked")
+        "compact_due", "ms_arr", "apply_stats", "ov_prev", "dup_acked",
+        "marks")
 
     def __init__(self):
         self.prepacked = None
@@ -1081,6 +1082,10 @@ class _IngestWave:
         self.seq_ms = 0.0
         self.apply_stats = {}
         self.ov_prev = None
+        # latency-attribution crossings (ISSUE 17): each stage method
+        # stamps its completion time here; the front door joins them
+        # with its own rx/decode timeline at ack-fan time
+        self.marks: dict = {}
 
 
 class StringServingEngine(ServingEngineBase):
@@ -1404,6 +1409,7 @@ class StringServingEngine(ServingEngineBase):
             # the executor barriers and the dispatch stage packs inline.
             w.prepacked = self.store.prepack_planes(
                 rows, kind, w.a0, w.a1, text, texts, tidx, props)
+        w.marks["pack1"] = time.perf_counter()
         return w
 
     def _ingest_sequence(self, w: "_IngestWave") -> None:
@@ -1457,6 +1463,7 @@ class StringServingEngine(ServingEngineBase):
             w.ms_arr = ms_arr
         w.seq_ms = (_t_seq - _t0) * 1000
         w.prep_ms += (time.perf_counter() - _t_seq) * 1000
+        w.marks["seq1"] = time.perf_counter()
 
     def _ingest_dispatch(self, w: "_IngestWave") -> None:
         """Stage 3 — the async device merge (zamboni fuses into the same
@@ -1508,6 +1515,7 @@ class StringServingEngine(ServingEngineBase):
                     pass
         else:
             self._flushes_since_compact += 1
+        w.marks["disp1"] = time.perf_counter()
 
     def _ingest_log(self, w: "_IngestWave") -> dict:
         """Stage 4 — the durable whole-batch append (ack barrier: poison
@@ -1614,8 +1622,9 @@ class StringServingEngine(ServingEngineBase):
             else:
                 self.recover_overflowed()
         n_dup = int(getattr(w, "dup_acked", 0) or 0)
+        w.marks["log1"] = time.perf_counter()
         return {"seq": w.seq_rs, "nacked": int(nacked.sum()) - n_dup,
-                "dup_acked": n_dup}
+                "dup_acked": n_dup, "marks": w.marks}
 
     # ----------------------------------------------------------- device side
 

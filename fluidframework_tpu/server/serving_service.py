@@ -95,6 +95,16 @@ class ServingLocalService(LocalService):
             self._row_doc[self._rows[key]] = doc_id
         return self._rows[key]
 
+    def _ops_tick(self) -> None:
+        """Live-gauge publisher for the ops-plane ticker (ISSUE 17): the
+        replica's current queue depth and row occupancy, readable at
+        scrape time instead of only in post-hoc snapshots."""
+        super()._ops_tick()
+        self.metrics.set_gauge("replica_queue_depth",
+                               float(len(self._replica_queue)))
+        self.metrics.set_gauge("replica_rows_used",
+                               float(len(self._rows)))
+
     def dropped_channels(self):
         """(doc, datastore, channel) keys shed because the replica was
         full — the operator-facing view of serving degradation."""

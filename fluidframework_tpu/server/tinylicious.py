@@ -132,6 +132,8 @@ class LocalService:
         for p in range(n_partitions):
             self.raw_log.subscribe(p, self._deli_consume)
             self.deltas_log.subscribe(p, self._deltas_consume)
+        #: live operations plane, attached on demand (ISSUE 17)
+        self._ops = None
 
     # ------------------------------------------------------------ front door
 
@@ -356,6 +358,7 @@ class LocalService:
         self.nacks = []
         self._connections = {}
         self._acked = {}
+        self._ops = None
         self.epoch = self._bump_epoch(spill_dir)
         # takeover edge: advance both logs' fence words and adopt the new
         # epoch — if the crashed instance is somehow still live (a
@@ -404,9 +407,35 @@ class LocalService:
         atomic_write_json(path, {"epoch": epoch})
         return epoch
 
+    # ------------------------------------------------------------ ops plane
+
+    def start_ops(self, host: str = "127.0.0.1", port: int = 0, **kw):
+        """Attach the live operations plane (``server.opsd.OpsServer``)
+        to this service: ``/metrics`` scrapes, ``/healthz`` SLO
+        scorecard, flight/trace debug routes, plus a ticker thread that
+        finally runs ``TimeSeriesStore`` sampling on a live server.
+        Subclasses publish their own gauges via :meth:`_ops_tick`.
+        Stopped by :meth:`close` (or explicitly via the returned
+        server)."""
+        from .opsd import OpsServer
+        ops = OpsServer(host=host, port=port, **kw)
+        ops.on_tick(self._ops_tick)
+        self._ops = ops.start()
+        return ops
+
+    def _ops_tick(self) -> None:
+        """Per-beat gauge publisher; subclasses override to add their
+        layer's live gauges (keep it cheap — it runs at scrape cadence)."""
+        REGISTRY.set_gauge("service_connections",
+                           float(len(self._connections)))
+
     # --------------------------------------------------------- fault testing
 
     def close(self) -> None:
+        ops = self._ops
+        if ops is not None:
+            self._ops = None
+            ops.stop()
         self.raw_log.close()
         self.deltas_log.close()
 

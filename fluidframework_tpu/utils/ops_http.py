@@ -1,0 +1,149 @@
+"""Tiny threaded HTTP server for the live operations plane (ISSUE 17).
+
+Stdlib-only (``http.server`` + ``socketserver``): no framework, no new
+dependencies, no event loop — each request is handled on its own daemon
+thread so a scrape can never block (or be blocked by) the asyncio
+ingress loops or the ingest executor workers.
+
+The server is a dumb router: callers register ``path -> handler`` where
+a handler takes the parsed query dict and returns ``(content_type,
+body_bytes)``.  Everything about *what* is served (Prometheus
+exposition, SLO scorecards, flight rings, span trees, hot-doc sketches)
+lives in :mod:`fluidframework_tpu.server.opsd`; this module only owns
+sockets and threads so it can be reused by tools and tests.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+__all__ = ["OpsHTTPServer", "json_body"]
+
+#: a route handler: (query dict) -> (content-type, body bytes)
+Handler = Callable[[Dict[str, str]], Tuple[str, bytes]]
+
+
+def json_body(obj) -> Tuple[str, bytes]:
+    """Serialize ``obj`` for an HTTP response, mapping non-finite floats
+    to ``null`` so the output stays strict RFC 8259 JSON (SLO scorecards
+    carry ``inf`` burn rates when a window has no samples)."""
+    text = json.dumps(obj, default=_jsonable, allow_nan=False)
+    return ("application/json; charset=utf-8", text.encode("utf-8"))
+
+
+def _jsonable(v):
+    try:
+        return str(v)
+    except Exception:
+        return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # per-request threads must not linger when a scraper goes away
+    timeout = 10
+    protocol_version = "HTTP/1.1"
+    server_version = "fluid-opsd"
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        parsed = urlparse(self.path)
+        route = self.server.routes.get(parsed.path)  # type: ignore[attr-defined]
+        if route is None:
+            body = json.dumps(
+                {"error": "no such route",
+                 "routes": sorted(self.server.routes)}).encode()
+            self._reply(404, "application/json; charset=utf-8", body)
+            return
+        query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+        try:
+            ctype, body = route(query)
+        except Exception as exc:  # surface handler bugs to the scraper
+            body = json.dumps({"error": repr(exc)}).encode()
+            self._reply(500, "application/json; charset=utf-8", body)
+            return
+        self._reply(200, ctype, body)
+
+    def _reply(self, code: int, ctype: str, body: bytes) -> None:
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper hung up mid-response; nothing to do
+
+    def log_message(self, fmt: str, *args) -> None:
+        pass  # stay silent: scrapes at 1 Hz would spam stderr
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # restart-after-crash friendliness (chaos_soak crash_restart re-binds)
+    allow_reuse_address = True
+
+    def __init__(self, addr, routes: Dict[str, Handler]):
+        self.routes = routes
+        super().__init__(addr, _Handler)
+
+
+class OpsHTTPServer:
+    """Threaded HTTP server with explicit route registration.
+
+    ``port=0`` binds an ephemeral port; read ``.port`` after
+    :meth:`start`.  ``start``/``stop`` are idempotent and the instance
+    doubles as a context manager.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self._want_port = port
+        self.port: int = port
+        self._routes: Dict[str, Handler] = {}
+        self._httpd: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- routes
+
+    def route(self, path: str, handler: Handler) -> "OpsHTTPServer":
+        """Register ``handler`` for exact-match ``path``. Chainable."""
+        self._routes[path] = handler
+        return self
+
+    @property
+    def routes(self) -> Dict[str, Handler]:
+        return dict(self._routes)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> "OpsHTTPServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = _Server((self.host, self._want_port), self._routes)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name=f"opsd-http:{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        httpd, thread = self._httpd, self._thread
+        self._httpd = self._thread = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def __enter__(self) -> "OpsHTTPServer":
+        return self.start()
+
+    def __exit__(self, *_exc) -> None:
+        self.stop()

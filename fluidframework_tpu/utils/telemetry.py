@@ -188,6 +188,10 @@ class Histogram:
             0.01 * (10 ** (i / 4)) for i in range(25)]
         self.counts = [0] * (len(self.bounds) + 1)
         self.n = 0
+        #: running sum of observed values — the Prometheus ``_sum`` sample;
+        #: also what latency attribution needs for exact (not
+        #: bucket-quantized) per-stage means
+        self.sum_ms = 0.0
         #: newest-last (value_ms, trace_id, span_id) triples
         self.exemplars: List[tuple] = []
         #: the exemplar with the largest value ever observed — the sample
@@ -197,6 +201,12 @@ class Histogram:
     def record(self, value_ms: float) -> None:
         self.counts[bisect.bisect_left(self.bounds, value_ms)] += 1
         self.n += 1
+        self.sum_ms += value_ms
+
+    @property
+    def mean(self) -> float:
+        """Exact mean of observed values (0.0 when empty)."""
+        return self.sum_ms / self.n if self.n else 0.0
 
     def observe(self, value_ms: float, exemplar: Any = None) -> None:
         """Record a sample; ``exemplar`` may be a ``TraceContext``-like
@@ -247,10 +257,20 @@ class Histogram:
 #: ≤30 ms budget against. 0.1 ms .. ~5.6 s.
 _FINE_BOUNDS = [0.1 * (10 ** (i / 16)) for i in range(75)]
 
+#: the stage-attribution grid keeps the fine sub-ms resolution but
+#: extends to ~100 s: under a contended storm the rx→ack end-to-end
+#: timeline legitimately reaches tens of seconds (windows queue behind
+#: the executor), and a p99 that falls off the grid reads as ``inf`` —
+#: useless as the sharding signal the breakdown exists to provide
+_STAGE_BOUNDS = [0.1 * (10 ** (i / 16)) for i in range(97)]
+
 #: name-prefix → bucket preset applied when ``observe`` lazily creates a
 #: histogram; first matching prefix wins
 BUCKET_PRESETS: List[tuple] = [
     ("ingest_", _FINE_BOUNDS),
+    # latency-attribution stage segments (ISSUE 17): sub-ms segments like
+    # the admission fence need the fine grid too
+    ("stage_", _STAGE_BOUNDS),
 ]
 
 
@@ -435,17 +455,22 @@ class MetricsRegistry:
 
     def render_prometheus(self, include_components: bool = True) -> str:
         """Prometheus text exposition (counters/gauges as single samples,
-        histograms as ``_bucket``/``_sum``-less cumulative bucket lines —
-        bounds are upper edges in ms, ``+Inf`` is the overflow bucket).
+        histograms as cumulative ``_bucket`` lines plus ``_sum``/``_count``
+        — bounds are upper edges in ms, ``+Inf`` is the overflow bucket).
         Labeled attachments carry their labels on every sample
         (``component="StringServingEngine",shard="3"``) — the per-shard /
-        per-replica / per-partition series of the mesh rollup scheme."""
+        per-replica / per-partition series of the mesh rollup scheme.
+        Label values are escaped per the text-format spec (backslash,
+        double quote, newline); serve with content-type
+        :data:`PROM_CONTENT_TYPE`."""
         lines: List[str] = []
 
         def emit(prefix: str, reg: "MetricsRegistry",
                  labels: Optional[Dict[str, str]] = None) -> None:
-            pairs = ([f'component="{prefix}"'] if prefix else []) + \
-                [f'{k}="{v}"' for k, v in sorted((labels or {}).items())]
+            pairs = ([f'component="{_prom_label_value(prefix)}"']
+                     if prefix else []) + \
+                [f'{k}="{_prom_label_value(v)}"'
+                 for k, v in sorted((labels or {}).items())]
             lab = "{" + ",".join(pairs) + "}" if pairs else ""
             comp = ",".join(pairs) + "," if pairs else ""
             for k in sorted(reg.counters):
@@ -464,6 +489,7 @@ class MetricsRegistry:
                     lines.append(
                         f'{name}_bucket{{{comp}le="{bound:g}"}} {cum}')
                 lines.append(f'{name}_bucket{{{comp}le="+Inf"}} {h.n}')
+                lines.append(f"{name}_sum{lab} {h.sum_ms}")
                 lines.append(f"{name}_count{lab} {h.n}")
 
         emit("", self)
@@ -474,10 +500,22 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+#: exposition content-type for :meth:`MetricsRegistry.render_prometheus`
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
 def _prom_name(name: str) -> str:
     """Sanitize a metric name for Prometheus exposition."""
     return "".join(ch if ch.isalnum() or ch == "_" else "_"
                    for ch in name)
+
+
+def _prom_label_value(value: Any) -> str:
+    """Escape a label value per the Prometheus text format: backslash,
+    double quote, and line feed are the three characters that would
+    otherwise break a scraper's line/quote parse."""
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
 
 
 class StageClock:

@@ -6,8 +6,9 @@ but because its *trajectory* stays inside a target. PR 2 gave this stack
 point-in-time metrics (``telemetry.MetricsRegistry``); this module adds
 the notion of time: a :class:`TimeSeriesStore` samples
 ``REGISTRY.full_snapshot()`` on a clock **the caller ticks** (bench.py
-phase boundaries, serving loops, tests — there is no background thread;
-determinism and zero idle cost are worth more than wall-clock cadence),
+phase boundaries, serving loops, tests — this module itself spawns no
+thread; on live servers the ``server.opsd.OpsServer`` ticker is the
+clock, everywhere else determinism and zero idle cost win),
 keeps a bounded ring of history per metric, derives rates from counters
 (reset-aware), and answers windowed percentile reads. ``utils.slo``
 evaluates burn-rate targets over it; ``tools/healthz.py`` renders it as

@@ -166,6 +166,10 @@ class _Cluster:
         applied) runs the offline scrubber with ``--repair`` semantics
         over the spill, then recovers again; outside corrupt mode the
         error propagates (a clean soak must never see one)."""
+        # the heavy-hitter sketch outlives the incarnation: the ops
+        # plane (if attached) keeps one whole-soak hot-doc view instead
+        # of resetting on every supervisor restart
+        hotdocs = self.server.hotdocs
         self.server.stop()
         self.service.close()
         try:
@@ -182,6 +186,7 @@ class _Cluster:
                 self.spill_dir, n_partitions=self.n_partitions)
         self.server = AlfredServer(
             self.service, port=self.port).start_in_thread()
+        self.server.hotdocs = hotdocs
         self.restarts += 1
 
     def stop(self) -> None:
@@ -193,15 +198,25 @@ def run_soak(seed: int = 0, steps: int = 400, n_clients: int = 4,
              kill_p: float = 0.01, restarts: int = 3,
              crash_p: float = 0.002, stall_p: float = 0.01,
              stall_s: float = 0.005, spill_dir: Optional[str] = None,
-             idle_timeout: float = 30.0, corrupt: bool = False) -> dict:
+             idle_timeout: float = 30.0, corrupt: bool = False,
+             ops_port: Optional[int] = None) -> dict:
     """Run one seeded soak; returns the report dict or raises
-    :class:`SoakViolation` / :class:`TimeoutError`."""
+    :class:`SoakViolation` / :class:`TimeoutError`. ``ops_port``
+    attaches a live :class:`server.opsd.OpsServer` (ticker ON — the
+    soak has no control loop of its own) that rides across every
+    crash-restart."""
     rng = random.Random(seed)
     tmp = None
     if spill_dir is None:
         tmp = tempfile.TemporaryDirectory(prefix="chaos_soak_")
         spill_dir = tmp.name
     cluster = _Cluster(spill_dir, corrupt_mode=corrupt)
+    ops = None
+    if ops_port is not None:
+        from fluidframework_tpu.server import opsd
+        ops = opsd.OpsServer(port=ops_port, registry=REGISTRY)
+        ops.add_hotdocs(cluster.server.hotdocs)
+        ops.start()
     # restart schedule: distinct step indices drawn up front so the
     # run is replayable and the restart count is exact, not expected
     restart_at = set(rng.sample(range(steps // 4, steps),
@@ -294,6 +309,8 @@ def run_soak(seed: int = 0, steps: int = 400, n_clients: int = 4,
     finally:
         for conn in clients:
             conn.close()
+        if ops is not None:
+            ops.stop()
         cluster.stop()
         if tmp is not None:
             tmp.cleanup()
@@ -348,13 +365,17 @@ def main() -> None:
                          "the raw spill before each restart; the run "
                          "fails unless every corruption is detected by "
                          "the checksum chain before apply")
+    ap.add_argument("--ops-port", type=int, default=None,
+                    help="serve the live ops plane (/metrics, /healthz, "
+                         "/debug/flights, ...) on this port; it rides "
+                         "across crash-restarts (0 = ephemeral)")
     args = ap.parse_args()
     if args.quick:
         args.steps, args.clients, args.restarts = 150, 3, 3
     report = run_soak(seed=args.seed, steps=args.steps,
                       n_clients=args.clients, restarts=args.restarts,
                       kill_p=args.kill_p, crash_p=args.crash_p,
-                      corrupt=args.corrupt)
+                      corrupt=args.corrupt, ops_port=args.ops_port)
     print(json.dumps(report, indent=2, sort_keys=True))
 
 

@@ -1,10 +1,10 @@
 #!/usr/bin/env python
-"""Text health dashboard over a TimeSeriesStore JSONL export.
+"""Text health dashboard: JSONL exports or a live ops endpoint.
 
-The operator-facing face of the health plane (ISSUE 4): bench.py (and
-any serving loop ticking a ``TimeSeriesStore`` with ``jsonl_path=``)
-leaves a JSONL trail of metric samples; this tool re-loads it and
-renders the two things an operator checks first:
+The operator-facing face of the health plane (ISSUE 4, live mode ISSUE
+17): bench.py (and any serving loop ticking a ``TimeSeriesStore`` with
+``jsonl_path=``) leaves a JSONL trail of metric samples; this tool
+re-loads it and renders the two things an operator checks first:
 
 - ``render_sparklines()`` — one line per active metric, recent shape +
   latest value + derived rate for counters;
@@ -12,25 +12,105 @@ renders the two things an operator checks first:
   default_slos()`` plus any ``--slo "metric < threshold"`` extras)
   judged over the export's history with fast/slow burn windows.
 
+With ``--url`` the same dashboard renders against a RUNNING server's
+operations plane (``server.opsd.OpsServer``): ``/metrics`` is polled at
+``--interval`` for ``--polls`` rounds to build the sparkline history,
+and the scorecard comes from the server's own ``/healthz`` (its
+SLOEngine has the full in-process history, not just our polls).
+
 Usage::
 
     python tools/healthz.py health.jsonl              # dashboard + SLOs
     python tools/healthz.py health.jsonl --names '*shard*'
     python tools/healthz.py --demo                    # synthetic sample
     python tools/healthz.py h.jsonl --slo "ops_ingested_rate > 100"
+    python tools/healthz.py --url http://127.0.0.1:9321 \
+        --interval 1 --polls 10                       # live server
 """
 
 from __future__ import annotations
 
 import argparse
 import fnmatch
+import json
 import os
+import re
 import sys
+import time
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 from fluidframework_tpu.utils import slo as slo_mod          # noqa: E402
 from fluidframework_tpu.utils import telemetry, timeseries   # noqa: E402
+
+#: one exposition sample line: name, optional {labels}, value
+_PROM_LINE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)$')
+#: one label pair inside the braces, value with text-format escapes
+_PROM_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return (v.replace(r"\n", "\n").replace(r'\"', '"')
+            .replace(r"\\", "\\"))
+
+
+def parse_prometheus(text: str):
+    """Parse a ``render_prometheus`` exposition back into the flat
+    ``full_snapshot``-style key space: top-level samples keep their
+    name, component-labeled samples become ``component.name`` (or
+    ``component{k=v,...}.name`` with extra labels — the registry's
+    component-key scheme). Histogram ``_bucket`` lines are skipped
+    (the ``_sum``/``_count`` pair carries the trend). Returns
+    ``(metrics, kinds)``."""
+    metrics, kinds, types = {}, {}, {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) == 4:
+                types[parts[2]] = parts[3]
+            continue
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if m is None:
+            continue
+        name, rawlabels, rawvalue = m.groups()
+        if name.endswith("_bucket"):
+            continue
+        try:
+            value = float(rawvalue)
+        except ValueError:
+            continue
+        labels = {k: _unescape(v)
+                  for k, v in _PROM_LABEL.findall(rawlabels or "")}
+        comp = labels.pop("component", None)
+        key = name
+        if comp is not None:
+            if labels:
+                inner = ",".join(f"{k}={labels[k]}"
+                                 for k in sorted(labels))
+                key = f"{comp}{{{inner}}}.{name}"
+            else:
+                key = f"{comp}.{name}"
+        metrics[key] = value
+        typ = types.get(name)
+        if typ is None and (name.endswith("_sum")
+                            or name.endswith("_count")):
+            base = name.rsplit("_", 1)[0]
+            if types.get(base) == "histogram":
+                typ = "counter"   # cumulative histogram accumulators
+        if typ in ("counter", "gauge"):
+            kinds[key] = typ
+        elif typ == "histogram":
+            kinds[key] = "counter"
+    return metrics, kinds
+
+
+def _fetch(url: str, timeout: float = 5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
 
 
 def _demo_store() -> timeseries.TimeSeriesStore:
@@ -46,11 +126,31 @@ def _demo_store() -> timeseries.TimeSeriesStore:
     return store
 
 
+def _live_store(base_url: str, interval_s: float, polls: int
+                ) -> timeseries.TimeSeriesStore:
+    """Build sparkline history by polling a live ``/metrics`` endpoint."""
+    store = timeseries.TimeSeriesStore(
+        registry=telemetry.MetricsRegistry())
+    for i in range(max(1, polls)):
+        if i:
+            time.sleep(interval_s)
+        text = _fetch(base_url + "/metrics").decode("utf-8")
+        metrics, kinds = parse_prometheus(text)
+        store.ingest_sample(time.time(), metrics, kinds=kinds)
+    return store
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("jsonl", nargs="?", help="TimeSeriesStore export")
     ap.add_argument("--demo", action="store_true",
                     help="render a synthetic store instead of a file")
+    ap.add_argument("--url", default=None, metavar="http://host:port",
+                    help="poll a live ops endpoint instead of a file")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between live polls (with --url)")
+    ap.add_argument("--polls", type=int, default=10,
+                    help="number of live polls to sample (with --url)")
     ap.add_argument("--names", default=None,
                     help="fnmatch filter on metric names")
     ap.add_argument("--width", type=int, default=24)
@@ -63,12 +163,22 @@ def main(argv=None) -> int:
                     help="skip the SLO scorecard")
     args = ap.parse_args(argv)
 
-    if args.demo:
+    live_rows = None
+    if args.url:
+        base = args.url.rstrip("/")
+        store = _live_store(base, args.interval, args.polls)
+        if not args.no_slo:
+            try:
+                live_rows = json.loads(
+                    _fetch(base + "/healthz")).get("rows") or []
+            except (OSError, ValueError):
+                live_rows = []
+    elif args.demo:
         store = _demo_store()
     elif args.jsonl:
         store = timeseries.TimeSeriesStore.from_jsonl(args.jsonl)
     else:
-        ap.error("either a JSONL path or --demo is required")
+        ap.error("a JSONL path, --demo, or --url is required")
     names = None
     if args.names:
         names = [n for n in store.names()
@@ -77,11 +187,16 @@ def main(argv=None) -> int:
                                   active_only=not args.all), end="")
     if args.no_slo:
         return 0
-    specs = slo_mod.default_slos() + [slo_mod.SLOSpec.parse(s)
-                                      for s in args.slo]
-    engine = slo_mod.SLOEngine(store, specs=specs,
-                               registry=store.registry)
-    rows = engine.scorecard()
+    if args.url:
+        # the server's own scorecard: its SLOEngine judged the full
+        # in-process history, not just the handful of polls we took
+        rows = live_rows
+    else:
+        specs = slo_mod.default_slos() + [slo_mod.SLOSpec.parse(s)
+                                          for s in args.slo]
+        engine = slo_mod.SLOEngine(store, specs=specs,
+                                   registry=store.registry)
+        rows = engine.scorecard()
     print()
     print(slo_mod.render_scorecard(rows), end="")
     # the dashboard reports; only an explicitly breaching scorecard row

@@ -54,6 +54,7 @@ IMPROVE = "improve"
 FLAT = "flat"
 NEW = "new"       # not enough prior rounds to judge
 INFO = "info"     # tracked but never failing
+STALE = "stale-record"   # floor declared after the newest committed record
 
 #: metrics where a LOWER newest value is the bad direction
 HIGHER_BETTER_HINTS = ("ops_per_sec", "per_sec")
@@ -83,6 +84,20 @@ DECLARED_FLOORS: Dict[str, float] = {
     # rounds report them unarmed/info rather than failing.
     "tree_serving_ops_per_sec": 5e5,
     "matrix_serving_ops_per_sec": 1e5,
+}
+
+#: round number each floor was declared in (ISSUE 17 satellite): a
+#: floor whose declaration postdates the newest COMMITTED ``BENCH_r*``
+#: record has never been verified by a committed run — the sentinel
+#: says so explicitly (``stale-record``, info-class: visibility, not a
+#: build failure) instead of silently judging it "unarmed". Keep this
+#: in sync when adding to DECLARED_FLOORS: the round of the PR that
+#: declares the floor.
+FLOOR_DECLARED_ROUND: Dict[str, int] = {
+    "serving_rich_ops_per_sec": 6,
+    "columnar_ingress_ops_per_sec": 6,
+    "tree_serving_ops_per_sec": 7,
+    "matrix_serving_ops_per_sec": 7,
 }
 
 #: Known-variance note (headline drift, r04 → r05): the merged-kernel
@@ -240,6 +255,40 @@ def judge_floors(rounds: List[dict]) -> List[dict]:
     return out
 
 
+def _round_number(stem: str) -> Optional[int]:
+    """``"BENCH_r04"`` → 4; None for stems that don't parse."""
+    digits = "".join(c for c in stem.rsplit("r", 1)[-1] if c.isdigit())
+    return int(digits) if digits else None
+
+
+def judge_staleness(rounds: List[dict]) -> List[dict]:
+    """``stale-record`` verdicts (ISSUE 17 satellite): one per declared
+    floor whose declaration round has NO newer committed ``BENCH_r*``
+    record. Info-class — the point is an explicit "this bar has never
+    been verified by a committed run", not a build failure (the
+    floor-arming logic already refuses to fail unachieved floors)."""
+    if not rounds:
+        return []
+    newest = rounds[-1]
+    newest_n = _round_number(newest.get("_round", ""))
+    if newest_n is None:
+        return []
+    out: List[dict] = []
+    for name, declared in sorted(FLOOR_DECLARED_ROUND.items()):
+        if name not in DECLARED_FLOORS or newest_n > declared:
+            continue
+        out.append({
+            "metric": name, "verdict": STALE,
+            "value": newest.get(name),
+            "expected": f">={DECLARED_FLOORS[name]:g} (declared floor)",
+            "delta_pct": None,
+            "note": f"floor declared in round {declared}; newest "
+                    f"committed record is {newest['_round']} — no "
+                    f"committed run verifies it yet",
+        })
+    return out
+
+
 def judge_resilience(rounds: List[dict]) -> List[dict]:
     """Hard gate on the newest round's reconnect-storm phase (ISSUE 9):
     ``invariant_violations`` is a correctness count, not a perf number —
@@ -342,7 +391,7 @@ def has_regression(verdicts: List[dict]) -> bool:
 
 def render_table(verdicts: List[dict], rounds: List[dict]) -> str:
     """Fixed-width verdict table, regressions first."""
-    order = {REGRESS: 0, IMPROVE: 1, NEW: 2, INFO: 3, FLAT: 4}
+    order = {REGRESS: 0, IMPROVE: 1, STALE: 2, NEW: 3, INFO: 4, FLAT: 5}
     rows = sorted(verdicts, key=lambda v: (order[v["verdict"]],
                                            v["metric"]))
     newest = rounds[-1]["_round"] if rounds else "?"
@@ -433,6 +482,7 @@ def main(argv=None) -> int:
     verdicts = judge(rounds, rel_band=args.rel_band,
                      k_sigma=args.k_sigma)
     verdicts += judge_floors(rounds)
+    verdicts += judge_staleness(rounds)
     verdicts += judge_resilience(rounds)
     verdicts += judge_overload(rounds)
     verdicts += judge_durability(rounds, spill_dir=args.spill_dir)
@@ -446,6 +496,13 @@ def main(argv=None) -> int:
         print(f"BENCHES.md {TRAJECTORY_HEADING!r} refreshed",
               file=sys.stderr)
     if args.check and not failed:
+        # stale-record is info-class but must stay VISIBLE in the quiet
+        # tier-1 mode: an unverified floor silently passing is the
+        # failure mode this verdict exists to prevent
+        for v in verdicts:
+            if v["verdict"] == STALE:
+                print(f"perf_sentinel: {STALE} — {v['metric']}: "
+                      f"{v['note']}")
         print(f"perf_sentinel: OK — {len(verdicts)} metrics within band "
               f"across {len(rounds)} rounds")
     return 1 if failed else 0

@@ -216,9 +216,13 @@ def run_sim(seed: int = 0, duration_s: float = 6.0,
             n_docs: int = 8, zipf_exponent: float = 1.2,
             slo_ms: float = 200.0, goodput_min: float = 0.8,
             control_every_s: float = 0.05, churn_p: float = 0.3,
-            idle_timeout: float = 30.0, quick: bool = False) -> dict:
+            idle_timeout: float = 30.0, quick: bool = False,
+            ops_port: Optional[int] = None) -> dict:
     """Run one seeded storm; returns the report dict or raises
-    :class:`SoakViolation` on an audit failure."""
+    :class:`SoakViolation` on an audit failure. ``ops_port`` attaches a
+    live :class:`server.opsd.OpsServer` for the storm's duration —
+    ticker disabled, since the sim's own control loop already samples
+    the store (and the control loop must stay the only SLO judge)."""
     rng = random.Random(seed)
     tenants = tenants if tenants is not None else default_tenants(quick)
     writers = [t for t in tenants if t.role == "writer"]
@@ -235,6 +239,14 @@ def run_sim(seed: int = 0, duration_s: float = 6.0,
     policy = ControlPolicy(adm, engine)
     service = LocalService()
     server = AlfredServer(service, admission=adm).start_in_thread()
+    ops = None
+    if ops_port is not None:
+        from fluidframework_tpu.server import opsd
+        ops = opsd.OpsServer(port=ops_port, registry=REGISTRY,
+                             store=store, slo_engine=engine,
+                             tick_interval_s=0.0)
+        ops.add_hotdocs(server.hotdocs)
+        ops.start()
     pick_doc = _zipf_picker(n_docs, zipf_exponent, rng)
 
     sessions: Dict[str, List[_Session]] = {t.name: [] for t in tenants}
@@ -290,6 +302,11 @@ def run_sim(seed: int = 0, duration_s: float = 6.0,
                          for lat in sess.admitted_latencies_ms()]
                 recent_lat = fresh[-512:]
                 REGISTRY.set_gauge("ack_p99_ms", _p99(recent_lat))
+                if ops is not None:
+                    # the hotdoc gauges ride the sim's own sampling beat
+                    # (the OpsServer ticker is off in this host)
+                    from fluidframework_tpu.server import opsd
+                    opsd.publish_hotdoc_gauges([server.hotdocs])
                 store.tick(now=now)
                 policy_trace.append(policy.tick(now=now))
                 if rng.random() < churn_p:
@@ -343,6 +360,8 @@ def run_sim(seed: int = 0, duration_s: float = 6.0,
         for pool in sessions.values():
             for sess in pool:
                 sess.conn.close()
+        if ops is not None:
+            ops.stop()
         server.stop()
         service.close()
 
@@ -474,13 +493,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "one-core CI")
     ap.add_argument("--check", action="store_true",
                     help="exit 1 unless every acceptance gate passes")
+    ap.add_argument("--ops-port", type=int, default=None,
+                    help="serve the live ops plane (/metrics, /healthz, "
+                         "/debug/hotdocs, ...) on this port for the "
+                         "storm's duration (0 = ephemeral)")
     args = ap.parse_args(argv)
     if args.quick:
         args.duration = min(args.duration, 1.6)
         args.slo_ms = max(args.slo_ms, 250.0)
     report = run_sim(seed=args.seed, duration_s=args.duration,
                      n_docs=args.docs, slo_ms=args.slo_ms,
-                     goodput_min=args.goodput_min, quick=args.quick)
+                     goodput_min=args.goodput_min, quick=args.quick,
+                     ops_port=args.ops_port)
     print(json.dumps(report, indent=2, sort_keys=True))
     if args.check and report["gate_failures"]:
         print(f"GATE FAILURES: {report['gate_failures']}",
