@@ -1825,6 +1825,7 @@ class TensorStringStore(StringOpInterner):
         store.last_rich_wire = None
         store._props_pack_cache = {}
         store._cidx_cache = None
+        store._tab_pool = {}
         store.device_reads = 0
         store._iv_min_seq = np.asarray(
             snap.get("iv_min_seq", [0] * n_docs), np.int64)

@@ -66,7 +66,7 @@ import numpy as np
 from ..core.protocol import ColumnarWireKind
 from ..utils import tracing
 from ..utils.backoff import Backoff, retry
-from ..utils.telemetry import REGISTRY
+from ..utils.telemetry import MetricsCollector, REGISTRY
 from . import native_ingress
 from .ingest_pipeline import PipelinedIngestExecutor
 from .opsd import SpaceSaving, observe_window_timeline
@@ -438,6 +438,33 @@ class ColumnarAlfred:
                  decode: str = "auto", max_rx_bytes: int = 8 << 20,
                  read_chunk: int = 256 << 10, admission=None):
         self.engine = engine
+        #: partitioned serving (ISSUE 18): when ``engine`` is a
+        #: ``server.partitioned.PartitionedStringServing`` wrapper
+        #: (feature-detected by its ``engines`` list), the drain pass
+        #: carves PER-PARTITION windows — partition segments are
+        #: contiguous after the stable row sort since global row =
+        #: partition * docs_per_partition + local — and each partition
+        #: gets its own ``PipelinedIngestExecutor``: N concurrent
+        #: sequencers behind one door.
+        part_engines = getattr(engine, "engines", None)
+        self.n_partitions = len(part_engines) if part_engines else 1
+        self._dpp = int(getattr(engine, "docs_per_partition", 0) or 0)
+        #: per-partition door collectors: the stage-latency timeline is
+        #: observed once globally AND once under a partition label, so
+        #: ``/debug/latency`` can split the storm by partition
+        self._part_colls: List[MetricsCollector] = []
+        if self.n_partitions > 1:
+            for p in range(self.n_partitions):
+                coll = MetricsCollector()
+                REGISTRY.attach("columnarDoor", coll,
+                                labels={"partition": p})
+                self._part_colls.append(coll)
+        #: optional ``server.partitioned.ReplicaDigestTap``: every
+        #: sequenced window is folded into the replicated shadow state
+        #: after its durable append, asserting cross-replica digest
+        #: parity per window (ISSUE 18 acceptance; bench partition
+        #: scaling attaches one on the virtual CPU mesh)
+        self.digest_tap = None
         #: optional server.admission.AdmissionController: decoded op
         #: planes are offered to it in the drain pass, BEFORE windows
         #: reach the executor; shed suffixes get a throttled frame
@@ -496,8 +523,11 @@ class ColumnarAlfred:
         self._prop_of: Dict[Tuple, int] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._wake: Optional[asyncio.Event] = None
-        self._executor: Optional[PipelinedIngestExecutor] = None
-        self._waves_inflight = 0
+        #: one executor per partition (a single-entry list when the
+        #: engine is unpartitioned); in-flight depth is tracked PER
+        #: partition so one saturated sequencer never blocks its peers
+        self._executors: List[PipelinedIngestExecutor] = []
+        self._waves_inflight = [0] * self.n_partitions
         self._capacity: Optional[asyncio.Event] = None
         self._pipeline_error: Optional[BaseException] = None
         #: heavy-hitter sketch over (doc, tenant), fed by the drain pass
@@ -509,6 +539,37 @@ class ColumnarAlfred:
         self._pass_tl: Optional[dict] = None
         self._pass_admit_ms = 0.0
         self._ops: Optional[object] = None   # attached OpsServer
+
+    # --------------------------------------------------------- partitions
+
+    @property
+    def _executor(self) -> Optional[PipelinedIngestExecutor]:
+        """Single-executor view (partition 0 / the sole executor) for
+        callers predating the partitioned door."""
+        return self._executors[0] if self._executors else None
+
+    def _engine_of(self, p: int):
+        """Partition ``p``'s live engine — resolved through the wrapper
+        on every call so a failover promotion swaps in transparently."""
+        engs = getattr(self.engine, "engines", None)
+        return engs[p] if engs else self.engine
+
+    def _part_of_rows(self, rows: np.ndarray) -> np.ndarray:
+        return rows // self._dpp if self._dpp else \
+            np.zeros(np.asarray(rows).shape, np.int64)
+
+    def rebind_executor(self, p: int) -> None:
+        """Post-failover hook: partition ``p``'s engine was swapped
+        (promotion); close the deposed engine's executor and pipeline
+        into the new authority."""
+        if not self._executors:
+            return
+        try:
+            self._executors[p].close()
+        except (RuntimeError, TimeoutError):
+            pass
+        self._executors[p] = PipelinedIngestExecutor(
+            self._engine_of(p), depth=self.pipeline_depth)
 
     # ------------------------------------------------------------ ingest side
 
@@ -856,21 +917,37 @@ class ColumnarAlfred:
         n = row.size
         order = np.argsort(row, kind="stable")
         srow = row[order]
-        new = np.empty(n, bool)
-        new[0] = True
-        new[1:] = srow[1:] != srow[:-1]
-        starts = np.flatnonzero(new)
-        occ = np.arange(n) - np.repeat(starts,
-                                       np.diff(np.append(starts, n)))
-        lvl_order = np.argsort(occ, kind="stable")
-        cuts = np.flatnonzero(np.diff(occ[lvl_order])) + 1
-        chunks: List[np.ndarray] = []
-        for lvl in np.split(order[lvl_order], cuts):
-            for s in range(0, lvl.size, self.window_min_rows):
-                chunks.append(lvl[s:s + self.window_min_rows])
+        # partitioned engine: global row = partition * dpp + local, so
+        # after the row sort partition runs are CONTIGUOUS — carve at
+        # partition boundaries FIRST, then occurrence levels per
+        # partition segment (each window then belongs to exactly one
+        # partition's sequencer/executor)
+        if self.n_partitions > 1:
+            pids = srow // self._dpp
+            pcuts = np.flatnonzero(np.diff(pids)) + 1
+            segs = [(int(pids[seg[0]]), seg)
+                    for seg in np.split(np.arange(n), pcuts)]
+        else:
+            segs = [(0, np.arange(n))]
+        chunks: List[Tuple[int, np.ndarray]] = []
+        for part, seg in segs:
+            so = srow[seg]
+            m = so.size
+            new = np.empty(m, bool)
+            new[0] = True
+            new[1:] = so[1:] != so[:-1]
+            starts = np.flatnonzero(new)
+            occ = np.arange(m) - np.repeat(starts,
+                                           np.diff(np.append(starts, m)))
+            lvl_order = np.argsort(occ, kind="stable")
+            cuts = np.flatnonzero(np.diff(occ[lvl_order])) + 1
+            oseg = order[seg]
+            for lvl in np.split(oseg[lvl_order], cuts):
+                for s in range(0, lvl.size, self.window_min_rows):
+                    chunks.append((part, lvl[s:s + self.window_min_rows]))
         texts_g, props_g = self._texts, self._props
         windows = []
-        for w in chunks:
+        for part, w in chunks:
             kind_w = f["kind"][w]
             gidx_w = f["gidx"][w]
             tidx_w = np.zeros(w.size, np.int32)
@@ -896,30 +973,55 @@ class ColumnarAlfred:
                 "client": f["client"][w].reshape(-1, 1),
                 "cseq_flat": f["cseq"][w], "sessi": sessi[w],
                 "texts": texts_w or [""], "props": props_w or None,
-                "tab": tab, "tl": self._pass_tl})
+                "tab": tab, "tl": self._pass_tl, "part": part})
         # the interners only feed this pass's windows, which now carry
         # their own compacted tables — reset so they stay bounded
         self._texts, self._text_of = [], {}
         self._props, self._prop_of = [], {}
+        if self.n_partitions > 1 and len(windows) > 1:
+            # interleave submission round-robin across partitions: the
+            # per-partition depth wait then parks on the SATURATED
+            # partition only after its peers' windows are already in
+            # flight (within a partition, level order — per-doc FIFO —
+            # is preserved: stable grouping keeps relative order)
+            byp: Dict[int, List[dict]] = {}
+            for w in windows:
+                byp.setdefault(w["part"], []).append(w)
+            queues = list(byp.values())
+            windows = []
+            i = 0
+            while queues:
+                q = queues[i % len(queues)]
+                windows.append(q.pop(0))
+                if q:
+                    i += 1
+                else:
+                    queues.remove(q)
         return windows
 
     def _submit_window(self, w: dict) -> None:
         n = int(w["rows"].size)
-        if self._executor is not None:
-            # pipelined front door: hand the window to the executor and
-            # return — the NEXT window aggregates while this one packs/
-            # sequences/dispatches; acks fan back from the done callback
-            # only after the durable append commits (ack-after-durable)
+        part = w.get("part", 0)
+        # the engine stages speak partition-LOCAL rows; the wire (acks,
+        # shed fences, hotdocs) keeps the door's global rows
+        loc = w["rows"] - part * self._dpp if self.n_partitions > 1 \
+            else w["rows"]
+        if self._executors:
+            # pipelined front door: hand the window to its partition's
+            # executor and return — the NEXT window aggregates while
+            # this one packs/sequences/dispatches; acks fan back from
+            # the done callback only after the durable append commits
+            # (ack-after-durable)
             with tracing.TRACER.maybe_root_span(
                     "columnar.submit_window", every=256, ops=n):
                 # sampled windows carry their trace context to the ack
                 # fan: the e2e histogram's exemplar names a real trace
                 w["ctx"] = tracing.TRACER.current()
-                ticket = self._executor.submit(
-                    w["rows"], w["client"], w["cseq"], w["ref"],
+                ticket = self._executors[part].submit(
+                    loc, w["client"], w["cseq"], w["ref"],
                     w["kind"], w["a0"], w["a1"], texts=w["texts"],
                     tidx=w["tidx"], props=w["props"])
-            self._waves_inflight += 1
+            self._waves_inflight[part] += 1
             loop = getattr(self, "_loop", None) or \
                 asyncio.get_running_loop()
             ticket.add_done_callback(
@@ -928,8 +1030,8 @@ class ColumnarAlfred:
             with tracing.TRACER.maybe_root_span(
                     "columnar.flush_window", every=256, ops=n):
                 w["ctx"] = tracing.TRACER.current()
-                res = self.engine.ingest_planes(
-                    w["rows"], w["client"], w["cseq"], w["ref"],
+                res = self._engine_of(part).ingest_planes(
+                    loc, w["client"], w["cseq"], w["ref"],
                     w["kind"], w["a0"], w["a1"], texts=w["texts"],
                     tidx=w["tidx"], props=w["props"])
             self._fan_acks(w, np.asarray(res["seq"]).reshape(-1),
@@ -956,6 +1058,13 @@ class ColumnarAlfred:
         sessi, tab = w["sessi"], w["tab"]
         self.engine.note_acked_planes(rows, w["client"].reshape(-1),
                                       cseq, seqs)
+        if self.digest_tap is not None:
+            # fold the sequenced window into the replicated shadow and
+            # assert cross-replica digest parity (ISSUE 18): the tap's
+            # on_window runs the shard_map step and records agreement
+            self.digest_tap.on_window(
+                rows, w["kind"], w["a0"], w["a1"], seqs,
+                w["client"], w["ref"])
         if self.admission is not None:
             # service-rate feedback for the deadline estimator: these
             # ops just finished sequencing + durable append
@@ -974,8 +1083,18 @@ class ColumnarAlfred:
         # window's timeline — attribute e2e to consecutive stage segments
         tl = w.get("tl")
         if tl is not None and marks:
-            observe_window_timeline(tl, marks, time.perf_counter(),
+            t_ack = time.perf_counter()
+            observe_window_timeline(tl, marks, t_ack,
                                     exemplar=w.get("ctx"))
+            if self._part_colls:
+                # same stage histograms, partition-labeled (ISSUE 18):
+                # /debug/latency?partition=p splits the storm by
+                # sequencer so a hot partition shows up as ITS stage
+                # walls, not a fleet-wide average
+                observe_window_timeline(
+                    tl, marks, t_ack,
+                    registry=self._part_colls[w.get("part", 0)],
+                    exemplar=w.get("ctx"))
 
     def _bounce_ack(self, loop, ticket, w: dict) -> None:
         """Ticket done-callback: runs on the executor's log worker —
@@ -986,7 +1105,7 @@ class ColumnarAlfred:
             pass   # loop already closed (shutdown race): acks are moot
 
     def _ack_wave(self, ticket, w: dict) -> None:
-        self._waves_inflight -= 1
+        self._waves_inflight[w.get("part", 0)] -= 1
         if self._capacity is not None:
             self._capacity.set()
         err = ticket.error()
@@ -1003,13 +1122,15 @@ class ColumnarAlfred:
         self._fan_acks(w, np.asarray(res["seq"]).reshape(-1),
                        marks=res.get("marks"))
 
-    async def _wait_capacity(self) -> None:
-        """Depth backpressure: park the flusher (event loop stays free to
-        accumulate more socket bytes) until a wave's durable append frees
-        an in-flight slot."""
-        if self._executor is None:
+    async def _wait_capacity(self, part: int = 0) -> None:
+        """Depth backpressure, per partition: park the flusher (event
+        loop stays free to accumulate more socket bytes) until one of
+        THIS partition's in-flight waves logs — a saturated partition
+        never holds back windows already interleaved behind it for its
+        peers (they were submitted first by the round-robin order)."""
+        if not self._executors:
             return
-        while self._waves_inflight >= self._executor.depth \
+        while self._waves_inflight[part] >= self._executors[part].depth \
                 and self._pipeline_error is None:
             self._capacity.clear()
             await self._capacity.wait()
@@ -1030,7 +1151,7 @@ class ColumnarAlfred:
                                        ) from self._pipeline_error
                 self._drain()
                 for w in self._build_windows():
-                    await self._wait_capacity()
+                    await self._wait_capacity(w.get("part", 0))
                     if self._pipeline_error is not None:
                         raise RuntimeError("pipelined ingest failed"
                                            ) from self._pipeline_error
@@ -1046,9 +1167,11 @@ class ColumnarAlfred:
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
-        if self.pipeline_depth > 0 and self._executor is None:
-            self._executor = PipelinedIngestExecutor(
-                self.engine, depth=self.pipeline_depth)
+        if self.pipeline_depth > 0 and not self._executors:
+            self._executors = [
+                PipelinedIngestExecutor(self._engine_of(p),
+                                        depth=self.pipeline_depth)
+                for p in range(self.n_partitions)]
         self._server = await asyncio.start_server(
             self._accept, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -1090,6 +1213,7 @@ class ColumnarAlfred:
         from .opsd import OpsServer
         ops = OpsServer(host=host, port=port, **kw)
         ops.add_hotdocs(self.hotdocs)
+        ops.add_partitions(self.partition_stats)
         self._ops = ops.start()
         return ops
 
@@ -1098,15 +1222,14 @@ class ColumnarAlfred:
         if ops is not None:
             self._ops = None
             ops.stop()
-        ex = self._executor
-        if ex is not None:
+        for ex in self._executors:
             # drain first: in-flight waves resolve (acks fan while the
             # loop is still alive), final occupancy gauges publish
             try:
                 ex.close()
             except (RuntimeError, TimeoutError):
                 pass
-            self._executor = None
+        self._executors = []
         loop = getattr(self, "_loop", None)
         if loop is not None:
             loop.call_soon_threadsafe(
@@ -1114,10 +1237,51 @@ class ColumnarAlfred:
             self._thread.join(timeout=5)
 
     def pipeline_stats(self) -> Optional[dict]:
-        """Occupancy/overlap evidence from the live executor (None when
-        serial)."""
-        ex = self._executor
-        return None if ex is None else ex.stats()
+        """Occupancy/overlap evidence from the live executor(s) (None
+        when serial). Partitioned door: the sole-executor shape plus a
+        ``per_partition`` list, with waves summed and occupancy/overlap
+        averaged over partitions."""
+        if not self._executors:
+            return None
+        if len(self._executors) == 1:
+            return self._executors[0].stats()
+        per = [ex.stats() for ex in self._executors]
+        stages = per[0]["stage_occupancy"]
+        return {
+            "waves": sum(s["waves"] for s in per),
+            "depth": self.pipeline_depth,
+            "max_inflight": max(s["max_inflight"] for s in per),
+            "stage_occupancy": {
+                k: sum(s["stage_occupancy"][k] for s in per) / len(per)
+                for k in stages},
+            "overlap": sum(s["overlap"] for s in per) / len(per),
+            "per_partition": per,
+        }
+
+    def partition_stats(self) -> List[dict]:
+        """Per-partition occupancy / backlog / residency for
+        ``/debug/partitions`` (ISSUE 18). Backlog counts this pass's
+        decoded-but-unwindowed ops plus waves still in flight."""
+        backlog = [0] * self.n_partitions
+        for part in list(self._parts):
+            for p, n in zip(*np.unique(self._part_of_rows(part["row"]),
+                                       return_counts=True)):
+                backlog[int(p)] += int(n)
+        base = getattr(self.engine, "partition_stats", None)
+        rows = base() if base is not None else [
+            {"partition": p} for p in range(self.n_partitions)]
+        for p, r in enumerate(rows):
+            r["backlog_ops"] = backlog[p]
+            r["waves_inflight"] = self._waves_inflight[p]
+            if p < len(self._executors):
+                s = self._executors[p].stats()
+                r["seq_dispatch_occupancy"] = \
+                    s["stage_occupancy"]["seq_dispatch"]
+                r["waves"] = s["waves"]
+            if "resident_docs" not in r:
+                r["resident_docs"] = getattr(self._engine_of(p),
+                                             "resident_docs", 0)
+        return rows
 
     def drain_stats(self) -> dict:
         """Decode-stage evidence (bench.py / storm bench): p50 drain

@@ -80,6 +80,12 @@ class DeliSequencer:
         # of truth is the log's persisted fence file, never a checkpoint
         # that may itself be stale.
         self.epoch = 0
+        # identity in a partitioned serving mesh (ISSUE 18): which Deli
+        # partition this sequencer orders. -1 = unpartitioned/sole
+        # sequencer; the partitioned wrapper stamps the real index so
+        # telemetry and the /debug/partitions plane can attribute a
+        # sequencer's occupancy to its partition.
+        self.partition = -1
 
     def _doc(self, doc_id: str) -> _DocState:
         if doc_id not in self._docs:

@@ -234,6 +234,8 @@ class NativeDeliAdapter:
         import time
         self.raw = _native if _native is not None else NativeDeli()
         self.clock = clock if clock is not None else time.time
+        # partition identity, mirroring DeliSequencer (ISSUE 18)
+        self.partition = -1
 
     def client_join(self, doc_id: str, client_id: int):
         from ..core.protocol import MessageType, SequencedDocumentMessage

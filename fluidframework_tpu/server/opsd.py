@@ -259,6 +259,7 @@ class OpsServer:
         self.ticks = 0
         self._t_started = time.time()
         self._sketches: List[SpaceSaving] = []
+        self._partition_providers: List[Callable[[], List[dict]]] = []
         self._on_tick: List[Callable[[], None]] = []
         self._tick_stop = threading.Event()
         self._ticker: Optional[threading.Thread] = None
@@ -268,7 +269,8 @@ class OpsServer:
                      .route("/debug/flights", self._r_flights)
                      .route("/debug/trace", self._r_trace)
                      .route("/debug/hotdocs", self._r_hotdocs)
-                     .route("/debug/latency", self._r_latency))
+                     .route("/debug/latency", self._r_latency)
+                     .route("/debug/partitions", self._r_partitions))
 
     # -------------------------------------------------------- attachments
 
@@ -276,6 +278,13 @@ class OpsServer:
         """Expose a drain-pass sketch at ``/debug/hotdocs`` and in the
         ``hotdoc_*`` gauges (multiple doors may each attach one)."""
         self._sketches.append(sketch)
+        return self
+
+    def add_partitions(self, provider: Callable[[], List[dict]]
+                       ) -> "OpsServer":
+        """Expose a partitioned door's per-partition rows (occupancy,
+        backlog, resident docs — ISSUE 18) at ``/debug/partitions``."""
+        self._partition_providers.append(provider)
         return self
 
     def on_tick(self, fn: Callable[[], None]) -> "OpsServer":
@@ -337,8 +346,32 @@ class OpsServer:
                     for key, count, err in merged[:k]],
         }))
 
-    def _r_latency(self, _q: Dict[str, str]) -> Tuple[str, bytes]:
+    def _r_latency(self, q: Dict[str, str]) -> Tuple[str, bytes]:
+        part = q.get("partition")
+        if part is not None:
+            # the partition dimension (ISSUE 18): the door observes the
+            # stage timeline a second time into a partition-labeled
+            # collector — serve THAT collector's breakdown
+            suffix = "{partition=%s}" % part
+            for key, reg in self.registry.components().items():
+                if key.endswith(suffix) and any(
+                        n.startswith("stage_") for n in reg.histograms):
+                    out = latency_breakdown(reg)
+                    out["partition"] = int(part)
+                    return json_body(_finite(out))
+            return json_body(_finite({"partition": int(part),
+                                      "stages": {}, "windows": 0}))
         return json_body(_finite(latency_breakdown(self.registry)))
+
+    def _r_partitions(self, _q: Dict[str, str]) -> Tuple[str, bytes]:
+        rows: List[dict] = []
+        for provider in self._partition_providers:
+            try:
+                rows.extend(provider())
+            except Exception as e:   # debug route: never 500 the plane
+                rows.append({"error": repr(e)})
+        return json_body(_finite({"count": len(rows),
+                                  "partitions": rows}))
 
     # ---------------------------------------------------------- lifecycle
 

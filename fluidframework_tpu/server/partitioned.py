@@ -1,0 +1,408 @@
+"""Partitioned serving (ISSUE 18): N Deli partitions behind one door.
+
+PR 12/13 measured the stack ENGINE-bound: one serial sequencer at
+``seq_dispatch`` occupancy 0.99 caps the drained columnar door. This
+module is the Kafka-partition parallelism move the reference
+architecture (Routerlicious Deli over partitioned Kafka topics) uses to
+scale ordering: documents hash across **N partition engines**, each a
+full ``StringServingEngine`` with
+
+- its OWN native sequencer (N concurrent ``seq_dispatch`` stages — the
+  ctypes sequencing call releases the GIL, so partition executors
+  genuinely overlap even on one core),
+- its OWN epoch-fenced durable oplog (PR 10's fence word, now one fence
+  file per partition: failover deposes exactly one partition's writer),
+- its OWN dedup ledger + member set (PR 9's session resilience holds
+  per-partition because a doc lives on exactly one partition).
+
+The door-facing surface presents ONE global doc-row space: global row
+``g = partition * docs_per_partition + local_row``, so routing inside
+the drain pass is a vectorized divmod over the already-gathered row
+plane — no per-op Python. :class:`ColumnarAlfred` detects this wrapper
+(``engines`` attribute), carves per-partition windows, and runs one
+``PipelinedIngestExecutor`` per partition.
+
+Routing is hash-based (``oplog.partition_of``) with hot-doc awareness:
+:class:`DocPartitionRouter` consumes the drain pass's Space-Saving
+sketch (PR 13) and rebalances not-yet-resident heavy hitters off a
+partition holding too many of them. Failover promotes a per-partition
+``parallel.replicated.OplogFollower``; cross-replica digest parity
+rides :class:`ReplicaDigestTap` (shard_map all-gather + pmax/pmin
+agreement per window on the ``(replica, docs)`` mesh).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import flight_recorder
+from ..utils.telemetry import MetricsCollector, REGISTRY, TelemetryLogger
+from .oplog import PartitionedLog, partition_of
+from .serving import StringServingEngine
+
+
+def partition_spill_dir(spill_dir: Optional[str], p: int) -> Optional[str]:
+    """Per-partition spill subtree: each partition's oplog (and its
+    fence word) lives under ``{spill_dir}/part{p}`` so fencing/failover
+    deposes exactly one partition's writer, never its peers'."""
+    if spill_dir is None:
+        return None
+    sub = os.path.join(spill_dir, f"part{p}")
+    os.makedirs(sub, exist_ok=True)
+    return sub
+
+
+class DocPartitionRouter:
+    """doc → partition map: FNV-1a hash (``oplog.partition_of``) plus a
+    bounded override table the skew guard maintains.
+
+    The hash is the steady state; overrides exist only for heavy
+    hitters the :meth:`check_skew` guard moved off an overloaded
+    partition. Overrides are only ever installed for docs that are NOT
+    yet resident (no allocated row) — a resident doc's planes live on
+    its partition's device store, and this tier does not migrate rows;
+    flagging without moving is still surfaced (counter + flight note)
+    so the operator sees the skew even when nothing can move."""
+
+    def __init__(self, n_partitions: int, max_overrides: int = 256):
+        self.n_partitions = int(n_partitions)
+        self.max_overrides = max_overrides
+        self.overrides: Dict[str, int] = {}
+        self.skew_flags = 0
+        self.rebalanced_docs = 0
+        self._lock = threading.Lock()
+
+    def route(self, doc_id: str) -> int:
+        p = self.overrides.get(doc_id)
+        return p if p is not None \
+            else partition_of(doc_id, self.n_partitions)
+
+    def check_skew(self, sketch, resident, k: int = 16,
+                   factor: float = 2.0) -> dict:
+        """Skew guard over the drain pass's heavy-hitter sketch.
+
+        ``sketch`` is an ``opsd.SpaceSaving`` over ``(doc, tenant)``
+        keys; ``resident(doc_id) -> bool`` says whether the doc already
+        holds a row. A partition holding more than ``factor ×`` its fair
+        share of the top-``k`` heavy hitters is flagged; its
+        non-resident heavy docs are re-routed (override) to the
+        partition currently holding the fewest heavy hitters. Returns
+        the report the ops plane serves."""
+        top = sketch.top(k)
+        heavy: List[str] = []
+        seen = set()
+        for key, _cnt, _err in top:
+            doc = key[0] if isinstance(key, tuple) else key
+            if isinstance(doc, str) and doc not in seen:
+                seen.add(doc)
+                heavy.append(doc)
+        loads = [0] * self.n_partitions
+        for d in heavy:
+            loads[self.route(d)] += 1
+        fair = max(1, math.ceil(factor * len(heavy) / self.n_partitions))
+        flagged = [p for p, n in enumerate(loads) if n > fair]
+        moved: List[Tuple[str, int, int]] = []
+        with self._lock:
+            for p in flagged:
+                self.skew_flags += 1
+                REGISTRY.inc("partition_skew_flags_total")
+                for d in heavy:
+                    if loads[p] <= fair:
+                        break
+                    if self.route(d) != p or resident(d):
+                        continue
+                    if len(self.overrides) >= self.max_overrides:
+                        break
+                    dst = int(np.argmin(loads))
+                    if dst == p:
+                        break
+                    self.overrides[d] = dst
+                    loads[p] -= 1
+                    loads[dst] += 1
+                    moved.append((d, p, dst))
+                    self.rebalanced_docs += 1
+                    REGISTRY.inc("partition_rebalanced_docs_total")
+        if flagged:
+            flight_recorder.note("partition_skew", flagged=flagged,
+                                 loads=loads, moved=len(moved))
+        return {"heavy": len(heavy), "loads": loads, "fair_share": fair,
+                "flagged": flagged, "moved": moved,
+                "overrides": len(self.overrides)}
+
+
+class ReplicaDigestTap:
+    """Cross-replica digest parity, asserted per submitted window.
+
+    A shadow replicated apply on the ``(replica, docs)`` mesh
+    (``parallel.mesh.make_mesh``): every sequenced window's op planes
+    are fed through ``parallel.replicated.make_replicated_step`` — each
+    replica ingests a disjoint 1/R slice, the ``all_gather`` over the
+    replica axis reassembles the full batch, and the ``pmax``/``pmin``
+    digest agreement is the race detector. The tap's state is a
+    replica-sharded shadow (it does not serve reads); what it buys is a
+    LIVE every-window parity assertion over the real sequenced stream,
+    accounted through ``ReplicaSetMetrics`` (per-replica labeled
+    collectors + ``replica_digest_divergence_total``)."""
+
+    def __init__(self, mesh, n_docs: int = 64, capacity: int = 64):
+        import jax.numpy as jnp
+        from ..ops.merge_tree_kernel import StringState
+        from ..parallel.mesh import REPLICA_AXIS
+        from ..parallel.replicated import (
+            ReplicaSetMetrics, make_replicated_step, shard_ops,
+            shard_state,
+        )
+        self.mesh = mesh
+        self.n_replicas = int(mesh.shape.get(REPLICA_AXIS, 1))
+        doc_shards = mesh.devices.size // self.n_replicas
+        # doc axis must split evenly over the docs mesh axis
+        self.n_docs = max(doc_shards,
+                          (n_docs // doc_shards) * doc_shards)
+        self._jnp = jnp
+        self._shard_ops = lambda *planes: shard_ops(mesh, *planes)
+        self._step = make_replicated_step(mesh, with_props=False)
+        self.state = shard_state(
+            StringState.create(self.n_docs, capacity, n_props=1), mesh)
+        self.metrics = ReplicaSetMetrics(mesh, name="PartitionReplicaSet")
+        self.windows = 0
+        self.agree_all = True
+
+    def on_window(self, rows, kind, a0, a1, seq, client, ref) -> bool:
+        """Fold one sequenced window into the shadow state; returns the
+        step's cross-replica digest agreement. Op axis is padded to a
+        replica multiple; empty slots are ``OpKind.NOOP``; rows fold
+        modulo the shadow's doc count. Content fidelity is irrelevant
+        here — what matters is that every replica folds the IDENTICAL
+        gathered batch, so divergence == a replica raced."""
+        from ..ops.schema import OpKind
+        jnp = self._jnp
+        flat = [np.asarray(x).reshape(-1).astype(np.int32)
+                for x in (kind, a0, a1, seq, client, ref)]
+        rmod = np.asarray(rows).reshape(-1).astype(np.int32) % self.n_docs
+        pad = (-rmod.size) % self.n_replicas
+        if pad:
+            rmod = np.concatenate([rmod, np.zeros(pad, np.int32)])
+            flat = [np.concatenate([x, np.zeros(pad, np.int32)])
+                    for x in flat]
+        kind_f, a0_f, a1_f, seq_f, client_f, ref_f = flat
+        o = rmod.size
+        cols = np.arange(o)
+        # (D, O) planes: one column per op, scattered onto its doc row;
+        # every other (row, col) slot is a NOOP pad
+        noop = int(OpKind.NOOP)
+        kind_p = np.full((self.n_docs, o), noop, np.int32)
+        # annotate folds as NOOP: the shadow runs with_props=False (the
+        # all-zero prop planes must stay untouched for that fast path)
+        kind_p[rmod, cols] = np.where(kind_f > int(OpKind.STR_REMOVE),
+                                      noop, kind_f)
+        planes = [jnp.asarray(kind_p)]
+        for src in (a0_f, a1_f, np.zeros(o, np.int32), seq_f,
+                    client_f, ref_f):
+            pl = np.zeros((self.n_docs, o), np.int32)
+            pl[rmod, cols] = src
+            planes.append(jnp.asarray(pl))
+        self.state, _digest, agree = self._step(
+            self.state, *self._shard_ops(*planes))
+        ok = self.metrics.on_step(agree, o)
+        self.windows += 1
+        self.agree_all = self.agree_all and ok
+        return ok
+
+
+class PartitionedStringServing:
+    """N ``StringServingEngine`` partitions behind one global row space.
+
+    The object the partition-aware :class:`ColumnarAlfred` serves: it
+    exposes the single-engine surface the door already speaks
+    (``n_docs``/``is_member``/``connect``/``doc_row``/
+    ``last_client_seq``/``note_acked_planes``/``_row_doc_id``) while
+    routing every call to the owning partition. Global row ``g`` maps
+    as ``(g // docs_per_partition, g % docs_per_partition)`` — the
+    drain pass routes whole windows with one vectorized divmod.
+
+    Failover: ``attach_follower(p)`` arms a warm standby
+    (``OplogFollower`` on the partition's own fenced log);
+    ``promote(p)`` fences the deposed leader FIRST, replays the durable
+    tail, and swaps the follower in — peers keep sequencing throughout
+    (no global stall; the chaos drill pins this)."""
+
+    #: door feature-detection flag (``getattr(engine, "engines", None)``)
+    partitioned = True
+
+    def __init__(self, n_partitions: int, docs_per_partition: int,
+                 capacity: int = 256, n_props: int = 4,
+                 batch_window: int = 10 ** 9, compact_every: int = 1,
+                 log_partitions: int = 2, sequencer: str = "native",
+                 spill_dir: Optional[str] = None, mesh=None,
+                 router: Optional[DocPartitionRouter] = None):
+        if n_partitions < 1:
+            raise ValueError("n_partitions must be >= 1")
+        self.n_partitions = int(n_partitions)
+        self.docs_per_partition = int(docs_per_partition)
+        self.n_docs = self.n_partitions * self.docs_per_partition
+        self.spill_dir = spill_dir
+        self.router = router if router is not None \
+            else DocPartitionRouter(n_partitions)
+        self.engines: List[StringServingEngine] = []
+        for p in range(self.n_partitions):
+            log = PartitionedLog(log_partitions,
+                                 partition_spill_dir(spill_dir, p),
+                                 "oplog")
+            eng = StringServingEngine(
+                n_docs=docs_per_partition, capacity=capacity,
+                n_props=n_props, batch_window=batch_window,
+                compact_every=compact_every, log=log,
+                sequencer=sequencer, mesh=mesh)
+            eng.deli.partition = p
+            self.engines.append(eng)
+        #: global row → doc id (hot-doc sketch + ack attribution)
+        self._row_doc_id: List[Optional[str]] = [None] * self.n_docs
+        #: armed warm standbys, one per partition at most
+        self._followers: Dict[int, object] = {}
+        #: partitions whose leader was killed (drill bookkeeping)
+        self.dead_partitions: set = set()
+        self.metrics = MetricsCollector()
+        REGISTRY.attach("partitionedServing", self.metrics)
+        self.telemetry = TelemetryLogger(None, "partitionedServing")
+
+    # ------------------------------------------------------------- routing
+
+    def partition_of_doc(self, doc_id: str) -> int:
+        return self.router.route(doc_id)
+
+    def split_rows(self, rows: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized global→(partition, local) row routing — the drain
+        pass's one divmod."""
+        rows = np.asarray(rows)
+        return (rows // self.docs_per_partition,
+                rows % self.docs_per_partition)
+
+    def resident(self, doc_id: str) -> bool:
+        return any((doc_id in e._doc_rows) for e in self.engines)
+
+    # --------------------------------------------- single-engine surface
+
+    def doc_row(self, doc_id: str) -> int:
+        p = self.router.route(doc_id)
+        local = self.engines[p].doc_row(doc_id)
+        g = p * self.docs_per_partition + local
+        self._row_doc_id[g] = doc_id
+        return g
+
+    def connect(self, doc_id: str, client_id: int):
+        return self.engines[self.router.route(doc_id)].connect(
+            doc_id, client_id)
+
+    def disconnect(self, doc_id: str, client_id: int):
+        return self.engines[self.router.route(doc_id)].disconnect(
+            doc_id, client_id)
+
+    def is_member(self, doc_id: str, client_id: int) -> bool:
+        return self.engines[self.router.route(doc_id)].is_member(
+            doc_id, client_id)
+
+    def last_client_seq(self, doc_id: str, client_id: int) -> int:
+        return self.engines[self.router.route(doc_id)].last_client_seq(
+            doc_id, client_id)
+
+    def note_acked_planes(self, rows, clients, client_seqs, seqs) -> None:
+        """Ack-ledger fan-in: split the window's global rows by owning
+        partition, forward each slice with partition-local rows. The
+        dedup ledger stays per-partition — cross-partition cseq
+        contiguity per session holds because a (doc, client) pair's ops
+        all land on ONE partition (cseqs are per-doc)."""
+        rows = np.asarray(rows)
+        parts, local = self.split_rows(rows)
+        clients = np.asarray(clients).reshape(-1)
+        client_seqs = np.asarray(client_seqs).reshape(-1)
+        seqs = np.asarray(seqs).reshape(-1)
+        for p in np.unique(parts).tolist():
+            m = parts == p
+            self.engines[p].note_acked_planes(
+                local[m], clients[m], client_seqs[m], seqs[m])
+
+    def read_text(self, doc_id: str) -> str:
+        return self.engines[self.router.route(doc_id)].read_text(doc_id)
+
+    def _doc_log_messages(self, doc_id: str):
+        return self.engines[self.router.route(doc_id)
+                            ]._doc_log_messages(doc_id)
+
+    def flush(self) -> int:
+        return sum(e.flush() for e in self.engines)
+
+    # ------------------------------------------------------------ failover
+
+    def attach_follower(self, p: int):
+        """Arm a warm standby for partition ``p``: a second engine
+        trailing the partition's fenced oplog (shared durable stream)."""
+        from ..parallel.replicated import OplogFollower
+        fol = OplogFollower(self.engines[p], family="string")
+        self._followers[p] = fol
+        return fol
+
+    def catch_up(self, p: int) -> int:
+        fol = self._followers.get(p)
+        return 0 if fol is None else fol.catch_up()
+
+    def kill_partition(self, p: int) -> None:
+        """Chaos hook: mark partition ``p``'s leader dead (the drill's
+        SIGKILL stand-in). Routing and peers are untouched — only
+        :meth:`promote` restores the partition's write path."""
+        self.dead_partitions.add(p)
+        self.metrics.inc("partition_kills_total")
+        flight_recorder.note("partition_killed", partition=p)
+
+    def promote(self, p: int):
+        """Failover edge for one partition: fence the deposed leader
+        (its next append raises ``FencedWriterError``), final catch-up
+        from the durable log, swap the follower in as partition ``p``'s
+        engine. Counts ``failover_promotions_total`` via the follower."""
+        fol = self._followers.pop(p, None)
+        if fol is None:
+            raise RuntimeError(f"no follower armed for partition {p}")
+        new_eng = fol.promote()
+        new_eng.deli.partition = p
+        old = self.engines[p]
+        self.engines[p] = new_eng
+        self.dead_partitions.discard(p)
+        self.metrics.inc("partition_promotions_total")
+        # re-point doc ids: rows carry over 1:1 (same log, same rows).
+        # doc_row() is idempotent here AND re-seeds the restored
+        # engine's columnar row caches (_row_doc_id/_row_handle), which
+        # a summary load leaves lazy — without this the first
+        # post-failover window would reject its rows.
+        for doc_id, local in list(new_eng._doc_rows.items()):
+            assert new_eng.doc_row(doc_id) == local
+            self._row_doc_id[p * self.docs_per_partition + local] = doc_id
+        return old
+
+    # ------------------------------------------------------- introspection
+
+    def partition_stats(self) -> List[dict]:
+        """Per-partition occupancy/residency rows for
+        ``/debug/partitions`` (the door adds backlog + executor
+        occupancy on top)."""
+        rows = []
+        for p, eng in enumerate(self.engines):
+            rows.append({
+                "partition": p,
+                "resident_docs": eng.resident_docs,
+                "sequenced_seq": sum(
+                    eng.deli.doc_seq(d) for d in list(eng._doc_rows)[:64]),
+                "writer_epoch": eng.writer_epoch,
+                "dead": p in self.dead_partitions,
+                "follower_armed": p in self._followers,
+            })
+        return rows
+
+    def rebalance(self, sketch, k: int = 16, factor: float = 2.0) -> dict:
+        """Run the skew guard against a drain-pass sketch."""
+        return self.router.check_skew(sketch, self.resident, k=k,
+                                      factor=factor)

@@ -527,6 +527,12 @@ class ServingEngineBase:
             self._doc_rows[doc_id] = row
         return self._doc_rows[doc_id]
 
+    @property
+    def resident_docs(self) -> int:
+        """Documents currently holding a device row (partition
+        occupancy: ``/debug/partitions`` reads this per engine)."""
+        return len(self._doc_rows)
+
     # ------------------------------------------- columnar-ingest row caches
 
     def _init_row_caches(self, n_docs: int) -> None:

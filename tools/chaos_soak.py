@@ -60,7 +60,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
@@ -349,6 +349,190 @@ def _audit(service: LocalService, clients, submitted, uid_marker) -> None:
                      durable=len(markers), expected=len(submitted[doc]))
 
 
+def run_partition_drill(seed: int = 0, n_partitions: int = 4,
+                        docs_per_partition: int = 8, waves: int = 6,
+                        n_clients: int = 3,
+                        spill_dir: Optional[str] = None) -> dict:
+    """Partitioned-serving failover drill (ISSUE 18): kill ONE Deli
+    partition mid-storm, promote its ``OplogFollower``, and audit that
+
+    1. the surviving partitions kept sequencing during the outage (no
+       global stall — their waves ack while the victim is dead),
+    2. exactly-once holds per (doc, cseq) across the promotion (acks
+       arrive once, seq > 0, no marker applies twice),
+    3. per-session clientSeq contiguity holds ACROSS partition
+       boundaries: every client writes docs on several partitions
+       through one socket, and after the failover each doc's dedup
+       cursor (join-time ``lcs``) equals exactly the waves acked — the
+       per-partition dedup ledgers never tore a session,
+    4. per-doc ordering matches submission order (durable stream parity
+       with the oracle text), and seqs stay strictly monotone,
+    5. the deposed leader is FENCED (its next durable append raises).
+
+    Deterministic by construction: one socket per client, waves drained
+    in phases (pre-kill / outage / post-promotion), the pipelined
+    executors still overlap N partitions' sequencing inside each phase.
+    """
+    import numpy as np
+    from fluidframework_tpu.server.columnar_ingress import (
+        _OP_DTYPE, ColumnarAlfred, ColumnarClient)
+    from fluidframework_tpu.server.oplog import FencedWriterError
+    from fluidframework_tpu.server.partitioned import (
+        PartitionedStringServing)
+
+    rng = random.Random(seed)
+    tmp = None
+    if spill_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="partition_drill_")
+        spill_dir = tmp.name
+    svc = PartitionedStringServing(n_partitions=n_partitions,
+                                   docs_per_partition=docs_per_partition,
+                                   capacity=1024, spill_dir=spill_dir)
+    door = ColumnarAlfred(svc, window_min_rows=16, window_ms=2.0,
+                          pipeline_depth=2).start_in_thread()
+    victim = rng.randrange(n_partitions)
+    # every client owns docs touching EVERY partition (cross-partition
+    # sessions are the point); single writer per doc keeps the ordering
+    # audit exact
+    docs_of: Dict[int, List[str]] = {}
+    names = iter(f"pd-{seed}-{i}" for i in range(10 ** 6))
+    for c in range(n_clients):
+        mine: List[str] = []
+        need = set(range(n_partitions))
+        while need:
+            d = next(names)
+            p = svc.partition_of_doc(d)
+            if p in need:
+                need.discard(p)
+                mine.append(d)
+        docs_of[c] = mine
+    clients = [ColumnarClient("127.0.0.1", door.port)
+               for _ in range(n_clients)]
+    rows_of = [cl.join(docs_of[c]) for c, cl in enumerate(clients)]
+    acks: Dict[Tuple[int, str], Dict[int, int]] = {
+        (c, d): {} for c in range(n_clients) for d in docs_of[c]}
+    sent: Dict[Tuple[int, str], int] = {k: 0 for k in acks}
+    t0 = time.perf_counter()
+
+    def send_wave(c: int, w: int, docs: List[str]) -> None:
+        ops = np.zeros(len(docs), _OP_DTYPE)
+        for i, d in enumerate(docs):
+            ops[i] = (rows_of[c][d], 0, 0, 0, 0, sent[(c, d)] + 1, 0)
+            sent[(c, d)] += 1
+        clients[c].send_ops([f"w{w}_"], ops)
+
+    def drain(c: int, expect: int) -> None:
+        got = 0
+        deadline = time.time() + 30
+        while got < expect:
+            if time.time() > deadline:
+                _violate("partition_drain_timeout", client=c,
+                         expected=expect, got=got)
+            fr = clients[c].recv_json()
+            if fr.get("t") != "acks":
+                _violate("partition_unexpected_frame", client=c,
+                         frame=str(fr.get("t")))
+            row_doc = {rows_of[c][d]: d for d in docs_of[c]}
+            for (cs, seq), r in zip(fr["acks"], fr["rows"]):
+                d = row_doc[r]
+                if seq <= 0:
+                    _violate("partition_nack", client=c, doc=d,
+                             cseq=int(cs), code=int(seq))
+                if cs in acks[(c, d)]:
+                    _violate("partition_double_ack", client=c, doc=d,
+                             cseq=int(cs))
+                acks[(c, d)][int(cs)] = int(seq)
+                got += 1
+
+    pre = waves // 2
+    for w in range(pre):
+        for c in range(n_clients):
+            send_wave(c, w, docs_of[c])
+    for c in range(n_clients):
+        drain(c, pre * len(docs_of[c]))
+
+    # --- outage: kill the victim partition's leader mid-storm --------
+    svc.attach_follower(victim)
+    deposed = svc.engines[victim]
+    svc.kill_partition(victim)
+    outage_waves = 2
+    survivors = {c: [d for d in docs_of[c]
+                     if svc.partition_of_doc(d) != victim]
+                 for c in range(n_clients)}
+    for w in range(pre, pre + outage_waves):
+        for c in range(n_clients):
+            send_wave(c, w, survivors[c])
+    for c in range(n_clients):
+        # no global stall: the surviving partitions' acks arrive while
+        # the victim is dead
+        drain(c, outage_waves * len(survivors[c]))
+
+    # --- failover: fence the deposed leader, promote the follower ----
+    svc.promote(victim)
+    door.rebind_executor(victim)
+    try:
+        deposed.log.open_for_append(deposed.writer_epoch)
+        _violate("deposed_leader_not_fenced", partition=victim)
+    except FencedWriterError:
+        pass
+
+    for w in range(pre + outage_waves, waves + outage_waves):
+        for c in range(n_clients):
+            send_wave(c, w, docs_of[c])
+    for c in range(n_clients):
+        drain(c, (waves - pre) * len(docs_of[c]))
+
+    # --- audits ------------------------------------------------------
+    for c in range(n_clients):
+        for d in docs_of[c]:
+            got = acks[(c, d)]
+            want = sent[(c, d)]
+            # exactly-once + per-session cseq contiguity: every cseq
+            # 1..N acked exactly once, across the partition boundary
+            if sorted(got) != list(range(1, want + 1)):
+                _violate("cseq_gap", client=c, doc=d, acked=len(got),
+                         submitted=want)
+            seqs = [got[cs] for cs in sorted(got)]
+            if any(b <= a for a, b in zip(seqs, seqs[1:])):
+                _violate("seq_not_monotone", doc=d)
+            # ordering parity: inserts at 0 ⇒ the oracle text is the
+            # wave markers in reverse submission order
+            ws = [w for w in range(waves + outage_waves)
+                  if not (pre <= w < pre + outage_waves
+                          and d not in survivors[c])]
+            expect = "".join(f"w{w}_" for w in reversed(ws))
+            txt = svc.read_text(d)
+            if txt != expect:
+                _violate("order_divergence", doc=d, got=txt,
+                         expected=expect)
+    # dedup-ledger continuity: a resumed session sees lcs == waves acked
+    # per doc, including docs on the promoted partition
+    probe = ColumnarClient("127.0.0.1", door.port)
+    probe.join(docs_of[0], client_id=clients[0].client_id)
+    for d in docs_of[0]:
+        if probe.lcs.get(d, 0) != sent[(0, d)]:
+            _violate("dedup_cursor_lost", doc=d,
+                     lcs=int(probe.lcs.get(d, 0)),
+                     submitted=sent[(0, d)])
+    probe.close()
+    report = {
+        "seed": seed, "partitions": n_partitions, "victim": victim,
+        "clients": n_clients, "waves": waves + outage_waves,
+        "ops_submitted": sum(sent.values()),
+        "ops_acked": sum(len(v) for v in acks.values()),
+        "outage_acked_ops": outage_waves * sum(
+            len(survivors[c]) for c in range(n_clients)),
+        "promotions": 1, "violations": 0,
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+    }
+    for cl in clients:
+        cl.close()
+    door.stop()
+    if tmp is not None:
+        tmp.cleanup()
+    return report
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description="randomized resilience soak (see module docstring)")
@@ -369,9 +553,21 @@ def main() -> None:
                     help="serve the live ops plane (/metrics, /healthz, "
                          "/debug/flights, ...) on this port; it rides "
                          "across crash-restarts (0 = ephemeral)")
+    ap.add_argument("--partitions", type=int, default=None,
+                    help="run the partitioned-serving failover drill "
+                         "(ISSUE 18) over N Deli partitions: kill one "
+                         "partition mid-storm, promote its "
+                         "OplogFollower, audit exactly-once/ordering/"
+                         "cseq-contiguity while the peers keep serving")
     args = ap.parse_args()
     if args.quick:
         args.steps, args.clients, args.restarts = 150, 3, 3
+    if args.partitions is not None:
+        report = run_partition_drill(seed=args.seed,
+                                     n_partitions=args.partitions,
+                                     n_clients=args.clients)
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return
     report = run_soak(seed=args.seed, steps=args.steps,
                       n_clients=args.clients, restarts=args.restarts,
                       kill_p=args.kill_p, crash_p=args.crash_p,

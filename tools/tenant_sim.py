@@ -217,7 +217,8 @@ def run_sim(seed: int = 0, duration_s: float = 6.0,
             slo_ms: float = 200.0, goodput_min: float = 0.8,
             control_every_s: float = 0.05, churn_p: float = 0.3,
             idle_timeout: float = 30.0, quick: bool = False,
-            ops_port: Optional[int] = None) -> dict:
+            ops_port: Optional[int] = None,
+            n_partitions: int = 4) -> dict:
     """Run one seeded storm; returns the report dict or raises
     :class:`SoakViolation` on an audit failure. ``ops_port`` attaches a
     live :class:`server.opsd.OpsServer` for the storm's duration —
@@ -237,7 +238,11 @@ def run_sim(seed: int = 0, duration_s: float = 6.0,
                               fast_window_s=0.6, slow_window_s=2.0),
     ])
     policy = ControlPolicy(adm, engine)
-    service = LocalService()
+    # --partitions N (ISSUE 18): width of the service's partitioned
+    # oplogs — the exactly-once / per-session order audits must hold at
+    # any partition count, since doc→partition fan-out changes which
+    # appends contend but never the per-doc total order
+    service = LocalService(n_partitions=n_partitions)
     server = AlfredServer(service, admission=adm).start_in_thread()
     ops = None
     if ops_port is not None:
@@ -497,6 +502,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="serve the live ops plane (/metrics, /healthz, "
                          "/debug/hotdocs, ...) on this port for the "
                          "storm's duration (0 = ephemeral)")
+    ap.add_argument("--partitions", type=int, default=4,
+                    help="partitioned-oplog width for the service under "
+                         "storm (ISSUE 18); the audits must pass at any "
+                         "width")
     args = ap.parse_args(argv)
     if args.quick:
         args.duration = min(args.duration, 1.6)
@@ -504,7 +513,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     report = run_sim(seed=args.seed, duration_s=args.duration,
                      n_docs=args.docs, slo_ms=args.slo_ms,
                      goodput_min=args.goodput_min, quick=args.quick,
-                     ops_port=args.ops_port)
+                     ops_port=args.ops_port,
+                     n_partitions=args.partitions)
     print(json.dumps(report, indent=2, sort_keys=True))
     if args.check and report["gate_failures"]:
         print(f"GATE FAILURES: {report['gate_failures']}",
