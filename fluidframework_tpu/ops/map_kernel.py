@@ -219,6 +219,20 @@ class TensorMapStore:
         self._key_ids: List[Dict[str, int]] = [dict() for _ in range(n_docs)]
         self._interner = ValueInterner()
 
+    # --------------------------------------------------------- capacity plane
+
+    def capacity_stats(self) -> dict:
+        """Capacity-plane report fragment (ISSUE 19)."""
+        from ..utils import capacity as _cap
+        host = _cap.list_nbytes(self.n_docs)
+        for ids in self._key_ids:
+            host += _cap.dict_nbytes(len(ids),
+                                     _cap.INT_DICT_ENTRY_BYTES)
+        host += _cap.interner_nbytes(len(self._interner),
+                                     80 * len(self._interner))
+        return {"host": {"interner": int(host)},
+                "device": {"state": _cap.device_nbytes(self.state)}}
+
     # ------------------------------------------------------------- interning
 
     def key_slot(self, doc: int, key: str) -> int:

@@ -210,6 +210,16 @@ class TensorMatrixStore:
             self._cell_ids[k] = len(self._cell_ids)
         return self._cell_ids[k]
 
+    def capacity_stats(self) -> dict:
+        """Capacity-plane report fragment (ISSUE 19)."""
+        from ..utils import capacity as _cap
+        host = _cap.dict_nbytes(len(self._cell_ids),
+                                _cap.INT_DICT_ENTRY_BYTES + 56)
+        host += _cap.interner_nbytes(len(self._interner),
+                                     80 * len(self._interner))
+        return {"host": {"interner": int(host)},
+                "device": {"state": _cap.device_nbytes(self.state)}}
+
     def value_handle(self, value) -> int:
         return self._interner.handle(value)
 
@@ -458,6 +468,16 @@ class ShardedMatrixStore:
 
     def value_handle(self, value) -> int:
         return self._interner.handle(value)
+
+    def capacity_stats(self) -> dict:
+        """Capacity-plane report fragment (ISSUE 19)."""
+        from ..utils import capacity as _cap
+        host = _cap.dict_nbytes(len(self._cell_ids),
+                                _cap.INT_DICT_ENTRY_BYTES + 56)
+        host += _cap.interner_nbytes(len(self._interner),
+                                     80 * len(self._interner))
+        return {"host": {"interner": int(host)},
+                "device": {"state": _cap.device_nbytes(self.state)}}
 
     def conservative_room(self, extra: int) -> bool:
         """Worst case: every pending cell mints on the fullest shard."""

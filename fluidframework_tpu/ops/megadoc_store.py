@@ -40,6 +40,14 @@ class MegaDocStringStore(StringOpInterner):
         self._runs_cache = None
         self._runs_state = None
 
+    # --------------------------------------------------------- capacity plane
+
+    def capacity_stats(self) -> dict:
+        """Capacity-plane report fragment (ISSUE 19)."""
+        from ..utils import capacity as _cap
+        return {"host": {"interner": self.interner_host_bytes()},
+                "device": {"state": _cap.device_nbytes(self.state)}}
+
     # ----------------------------------------------------------------- apply
 
     def apply_messages(self, messages) -> None:
@@ -269,6 +277,7 @@ class MegaDocStringStore(StringOpInterner):
             for k in STATE_SPECS
         })
         store._payloads = [tuple(p) for p in snap["payloads"]]
+        store._payload_chars = sum(len(p[1]) for p in store._payloads)
         store._client_idx = [dict(m) for m in snap["client_idx"]]
         store._prop_planes = dict(snap["prop_planes"])
         from .schema import ValueInterner

@@ -349,6 +349,9 @@ class StringOpInterner:
 
     def _init_interner(self, n_docs: int, n_props: int) -> None:
         self._payloads: List[Tuple[int, str]] = [(_TEXT, "")]  # handle 0
+        # capacity plane (ISSUE 19): payload text chars, maintained O(1)
+        # at every growth point so a census never walks the table
+        self._payload_chars = 0
         self._client_idx: List[Dict[int, int]] = [dict()
                                                   for _ in range(n_docs)]
         # annotate: property KEYS intern to plane indexes (store-wide),
@@ -383,6 +386,7 @@ class StringOpInterner:
 
     def _payload(self, kind: int, text: str) -> int:
         self._payloads.append((kind, text))
+        self._payload_chars += len(text)
         return len(self._payloads) - 1
 
     def _prop_plane(self, key: str) -> int:
@@ -518,6 +522,30 @@ class StringOpInterner:
                     for key in sorted(op["props"])]
         raise ValueError(f"unknown op {op['mt']!r}")
 
+    # ------------------------------------------------------ capacity plane
+
+    def interner_host_bytes(self) -> int:
+        """Host-byte estimate of the interner tables (capacity plane,
+        ISSUE 19). Payload chars are a counter maintained at every
+        growth point, so this is a cheap roll-up — never a table walk.
+        Per-payload constant: tuple(2) 56 + str header 49 + list slot 8
+        (the kind ints are shared small-int singletons)."""
+        from ..utils import capacity as _cap
+        n_pay = len(self._payloads)
+        total = getattr(self, "_payload_chars", 0) + n_pay * (56 + 49 + 8)
+        total += _cap.list_nbytes(len(self._client_idx))
+        for m in self._client_idx:          # n_docs small dicts: ~1ms/10k
+            total += _cap.dict_nbytes(len(m), _cap.INT_DICT_ENTRY_BYTES)
+        total += _cap.dict_nbytes(len(self._prop_planes))
+        # value interner: JSON-encoded key strings + value objects; a
+        # flat per-entry constant (values are small scalars/strings)
+        total += _cap.interner_nbytes(len(self._prop_values),
+                                      80 * len(self._prop_values))
+        total += _cap.dict_nbytes(
+            len(getattr(self, "_props_pack_cache", ())),
+            _cap.INT_DICT_ENTRY_BYTES)
+        return int(total)
+
 
 class TensorStringStore(StringOpInterner):
     #: Pallas dispatch policy — "auto": fused VMEM kernel on TPU for
@@ -572,6 +600,24 @@ class TensorStringStore(StringOpInterner):
         # this batch need crossing bookkeeping at all" check must be O(1),
         # not a scan of n_docs dicts
         self._iv_docs: set = set()
+
+    # --------------------------------------------------------- capacity plane
+
+    def capacity_stats(self) -> dict:
+        """Capacity-plane report fragment (ISSUE 19): host interner +
+        interval bookkeeping, device plane bytes (sums to what
+        ``jax.live_arrays()`` sees for this store's state)."""
+        from ..utils import capacity as _cap
+        n_iv = sum(len(d) for d in self._intervals)
+        host = {
+            "interner": self.interner_host_bytes(),
+            # interval records: dict entry + 2 anchor tuples + props
+            "intervals": (_cap.dict_nbytes(n_iv, 250)
+                          + _cap.list_nbytes(self.n_docs) * 2
+                          + _cap.ndarray_nbytes(self._iv_min_seq)),
+        }
+        return {"host": host,
+                "device": {"state": _cap.device_nbytes(self.state)}}
 
     # ----------------------------------------------------------------- apply
 
@@ -730,6 +776,7 @@ class TensorStringStore(StringOpInterner):
             else:
                 t_list = [text] * len(flat_ins)
             self._payloads.extend((_TEXT, t) for t in t_list)
+            self._payload_chars += sum(map(len, t_list))
             a2_np = np.zeros((R, O), np.int32)
             a2_np.reshape(-1)[flat_ins] = np.arange(
                 base_h, base_h + len(flat_ins), dtype=np.int32)
@@ -756,6 +803,7 @@ class TensorStringStore(StringOpInterner):
                                     dtype=np.int32)
             lens_tab = np.fromiter(map(len, texts), np.int32,
                                    count=len(texts))
+            self._payload_chars += int(lens_tab.sum())
         elif ins.any():
             handles_tab = np.array([self._payload(_TEXT, text)],
                                    np.int32)
@@ -1728,6 +1776,8 @@ class TensorStringStore(StringOpInterner):
         store: overwrite the dirty rows' device planes in one dispatch,
         extend the append-only interner tables, replace interval state."""
         self._payloads.extend(tuple(p) for p in delta["payloads_delta"])
+        self._payload_chars += sum(
+            len(p[1]) for p in delta["payloads_delta"])
         self._prop_planes = dict(delta["prop_planes"])
         self._prop_values.extend_from(delta["prop_values_delta"])
         self._has_props = self._has_props or delta["has_props"]
@@ -1810,6 +1860,7 @@ class TensorStringStore(StringOpInterner):
             from ..parallel.sharded import shard_store_state
             store.state = shard_store_state(store.state, mesh)
         store._payloads = [tuple(p) for p in snap["payloads"]]
+        store._payload_chars = sum(len(p[1]) for p in store._payloads)
         store._client_idx = [dict(m) for m in snap["client_idx"]]
         store._prop_planes = dict(snap["prop_planes"])
         store._prop_values = ValueInterner.restore(snap["prop_values"])

@@ -404,6 +404,22 @@ class TensorTreeStore:
         self._wire_pool: Dict[tuple, list] = {}
         self._map_pool: Dict[int, list] = {}
 
+    # --------------------------------------------------------- capacity plane
+
+    def capacity_stats(self) -> dict:
+        """Capacity-plane report fragment (ISSUE 19): interner tables
+        host-side, tree planes device-side."""
+        from ..utils import capacity as _cap
+        host = 0
+        for it in (self._ids, self._fields, self._types):
+            # names list + ids dict; ~24 chars/name payload average
+            host += _cap.interner_nbytes(len(it._names),
+                                         73 * len(it._names))
+        host += _cap.interner_nbytes(len(self._values),
+                                     80 * len(self._values))
+        return {"host": {"interner": int(host)},
+                "device": {"state": _cap.device_nbytes(self.state)}}
+
     # ----------------------------------------------------------- translation
 
     @property

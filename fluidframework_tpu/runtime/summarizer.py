@@ -78,6 +78,29 @@ class SummaryGenerationStore:
         self.directory = directory
         self.keep = keep
         os.makedirs(directory, exist_ok=True)
+        # capacity plane (ISSUE 19): the generation store owns disk,
+        # not heap — its census charge is the kept blobs' recorded
+        # sizes (manifest reads, O(keep))
+        from ..utils import capacity as _cap
+        self._capacity_key = _cap.LEDGER.register(
+            "SummaryGenerationStore", self.capacity_stats)
+
+    def capacity_stats(self) -> dict:
+        """Capacity report: bytes of every kept generation blob, from
+        the manifests' recorded sizes (no blob reads)."""
+        from ..utils.atomicfile import read_json
+        total = 0
+        gens = self.generations()
+        for gen in gens:
+            try:
+                m = read_json(os.path.join(self.directory,
+                                           self._MANIFEST.format(gen)))
+                total += int(m.get("size", 0))
+            except (OSError, ValueError):
+                continue
+        return {"host": {"summary_disk": total},
+                "device": {}, "docs": 0,
+                "generations": len(gens), "heaviest": []}
 
     # ------------------------------------------------------------- save
     def generations(self) -> List[int]:

@@ -64,7 +64,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.protocol import ColumnarWireKind
-from ..utils import tracing
+from ..utils import capacity, tracing
 from ..utils.backoff import Backoff, retry
 from ..utils.telemetry import MetricsCollector, REGISTRY
 from . import native_ingress
@@ -533,6 +533,14 @@ class ColumnarAlfred:
         #: heavy-hitter sketch over (doc, tenant), fed by the drain pass
         #: (ISSUE 17) — the hot-doc routing/eviction signal
         self.hotdocs = SpaceSaving(capacity=256)
+        #: per-row last-touch clock (capacity plane, ISSUE 19): stamped
+        #: from the same ``np.unique`` pass that feeds the hot-doc
+        #: sketch — one vectorized scatter per drained part, no per-op
+        #: cost. Rows are GLOBAL rows, so one tracker covers the
+        #: partitioned engine too.
+        self.idle_ages = capacity.IdleAgeTracker()
+        capacity.LEDGER.add_idle_tracker(
+            "ColumnarAlfred", self.idle_ages, row_doc_id=self._doc_of_row)
         #: latency-attribution timeline of the current drain pass:
         #: rx/drain/decode/admit crossings every window of the pass
         #: inherits (the executor marks + ack fan complete it)
@@ -869,16 +877,27 @@ class ColumnarAlfred:
                                        (gidx, cseq, ref, client))
         return row, kind, a0, a1, gidx, cseq, ref, client
 
+    def _doc_of_row(self, r: int):
+        """Row index → doc id for the capacity plane's coldest-doc
+        census (bound method so the ledger's weak registration never
+        pins the door)."""
+        docs = getattr(self.engine, "_row_doc_id", None)
+        if docs is not None and 0 <= r < len(docs):
+            return docs[r]
+        return None
+
     def _note_hotdocs(self, row: np.ndarray, cid: int) -> None:
         """Feed the heavy-hitter sketch from one session's admitted
         planes: one ``offer`` per unique (doc, tenant) in the part, not
-        per op — O(unique rows) per drain, bounded memory overall."""
+        per op — O(unique rows) per drain, bounded memory overall.
+        The same unique pass stamps the idle-age clock: one scatter."""
         if self.admission is not None:
             tenant = self.admission.tenant_of(cid)
         else:
             tenant = f"client-{cid}"
         docs = getattr(self.engine, "_row_doc_id", None)
         u, counts = np.unique(row, return_counts=True)
+        self.idle_ages.touch(u)
         for r, n in zip(u.tolist(), counts.tolist()):
             doc = None
             if docs is not None and r < len(docs):

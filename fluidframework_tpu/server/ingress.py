@@ -19,12 +19,12 @@ from __future__ import annotations
 import argparse
 import asyncio
 import threading
-from typing import Optional
+from typing import Dict, List, Optional
 
 from . import wire
 from .tinylicious import DeltaConnection, LocalService
 from ..core.protocol import MessageType
-from ..utils import tracing
+from ..utils import capacity, tracing
 from ..utils.backoff import Backoff
 from ..utils.faultpoints import CrashInjected
 from ..utils.telemetry import REGISTRY
@@ -213,6 +213,7 @@ class _Session:
                       if adm is not None
                       else f"client-{self.conn.client_id}")
             self.server.hotdocs.offer((self.conn.doc_id, tenant))
+            self.server.touch_doc(self.conn.doc_id)
             # the frame carried the client's wire-span context across the
             # socket: re-attach so the synchronous pipeline (deli → apply
             # → broadcast) parents under the client's trace
@@ -339,7 +340,30 @@ class AlfredServer:
         #: signal as the columnar door's, fed per admitted op (ISSUE 17)
         from .opsd import SpaceSaving
         self.hotdocs = SpaceSaving(capacity=256)
+        #: idle-age clock (capacity plane, ISSUE 19). LocalService is
+        #: doc-keyed — no row planes — so the door allocates its own
+        #: stable doc slots; this door is already per-op, so a per-op
+        #: touch matches its cost model (the columnar door amortizes)
+        self.idle_ages = capacity.IdleAgeTracker()
+        self._idle_rows: Dict[str, int] = {}
+        self._idle_docs: List[str] = []
+        capacity.LEDGER.add_idle_tracker(
+            "AlfredServer", self.idle_ages, row_doc_id=self._doc_of_row)
         self._ops = None
+
+    def _doc_of_row(self, r: int) -> Optional[str]:
+        """Idle slot → doc id for the coldest-doc census."""
+        return self._idle_docs[r] if 0 <= r < len(self._idle_docs) \
+            else None
+
+    def touch_doc(self, doc_id: str) -> None:
+        """Stamp ``doc_id``'s idle-age slot (allocating it on first
+        touch)."""
+        r = self._idle_rows.get(doc_id)
+        if r is None:
+            r = self._idle_rows[doc_id] = len(self._idle_docs)
+            self._idle_docs.append(doc_id)
+        self.idle_ages.touch((r,))
 
     async def start(self, bind_attempts: int = 5,
                     base_delay: float = 0.05) -> None:

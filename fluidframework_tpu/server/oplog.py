@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import capacity
 from ..utils.atomicfile import atomic_write_json, read_json
 from ..utils.faultpoints import (
     SITE_OPLOG_MID_APPEND, SITE_OPLOG_MID_SPILL, fault_point,
@@ -260,6 +261,10 @@ class PartitionedLog:
         self.spill_dir = spill_dir
         self.name = name
         self._parts: List[List[Any]] = [[] for _ in range(n_partitions)]
+        # capacity plane (ISSUE 19): host bytes of each partition's
+        # in-memory tail, recharged O(1) per append (recomputed on
+        # recover) so a census never walks the record lists
+        self._mem_bytes: List[int] = [0] * n_partitions
         self._subs: List[List[Callable[[int, int, Any], None]]] = [
             [] for _ in range(n_partitions)]
         # per-partition locks: each partition's list, spill handle, and
@@ -401,6 +406,7 @@ class PartitionedLog:
         for i, recs in enumerate(records):
             log._parts[i] = recs
             log._chains[i] = chains[i]
+            log._mem_bytes[i] = sum(map(capacity.record_nbytes, recs))
         return log
 
     def append(self, partition: int, record: Any,
@@ -425,6 +431,7 @@ class PartitionedLog:
             part = self._parts[partition]
             offset = len(part)
             part.append(record)
+            self._mem_bytes[partition] += capacity.record_nbytes(record)
             REGISTRY.inc("oplog_appends")
             # crash here = record in memory, nothing durable, NOT acked
             fault_point(SITE_OPLOG_MID_APPEND, partition=partition,
@@ -475,3 +482,17 @@ class PartitionedLog:
     def size(self, partition: int) -> int:
         with self._plocks[partition]:
             return len(self._parts[partition])
+
+    def mem_stats(self) -> dict:
+        """Capacity-plane roll-up (ISSUE 19): in-memory tail bytes and
+        record counts per partition, O(n_partitions) — the byte
+        counters are maintained at append time, never recomputed."""
+        parts = []
+        for i in range(self.n_partitions):
+            with self._plocks[i]:
+                parts.append({"partition": i,
+                              "records": len(self._parts[i]),
+                              "bytes": int(self._mem_bytes[i])})
+        return {"parts": parts,
+                "records": sum(p["records"] for p in parts),
+                "total_bytes": sum(p["bytes"] for p in parts)}

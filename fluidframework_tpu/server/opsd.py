@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..utils import capacity as _capacity
 from ..utils import flight_recorder as _flight
 from ..utils import slo as _slo
 from ..utils import tracing as _tracing
@@ -270,7 +271,9 @@ class OpsServer:
                      .route("/debug/trace", self._r_trace)
                      .route("/debug/hotdocs", self._r_hotdocs)
                      .route("/debug/latency", self._r_latency)
-                     .route("/debug/partitions", self._r_partitions))
+                     .route("/debug/partitions", self._r_partitions)
+                     .route("/debug/memory", self._r_memory)
+                     .route("/debug/docs", self._r_docs))
 
     # -------------------------------------------------------- attachments
 
@@ -373,6 +376,33 @@ class OpsServer:
         return json_body(_finite({"count": len(rows),
                                   "partitions": rows}))
 
+    def _r_memory(self, q: Dict[str, str]) -> Tuple[str, bytes]:
+        """Capacity census (ISSUE 19): host planes by owner/category,
+        device buffers by engine, compile-cache stats, budget headroom.
+        ``?device=0`` skips the live-array walk; ``?k=N`` sizes the
+        heaviest/coldest lists."""
+        try:
+            census = _capacity.LEDGER.census(
+                top_k=int(q.get("k", "8")),
+                device=q.get("device", "1") not in ("0", "false"),
+                device_ttl_s=5.0)
+        except Exception as e:   # debug route: never 500 the plane
+            census = {"error": repr(e)}
+        return json_body(_finite(census))
+
+    def _r_docs(self, q: Dict[str, str]) -> Tuple[str, bytes]:
+        """Doc-level residency view: resident counts by owner, top-K
+        heaviest docs, top-K coldest (exact last-touch stamps)."""
+        try:
+            census = _capacity.LEDGER.census(
+                top_k=int(q.get("k", "16")), device=False)
+            out = {"docs": census["docs"], "idle": census["idle"],
+                   "heaviest": census["top"]["heaviest"],
+                   "coldest": census["top"]["coldest"]}
+        except Exception as e:
+            out = {"error": repr(e)}
+        return json_body(_finite(out))
+
     # ---------------------------------------------------------- lifecycle
 
     def start(self) -> "OpsServer":
@@ -424,6 +454,14 @@ class OpsServer:
                 fn()
             except Exception:
                 pass
+        # capacity gauges BEFORE the SLO check so memory_budget_headroom
+        # is judged against this beat's census (device walk TTL-cached —
+        # the 1 Hz ticker stays within the scrape-overhead bound)
+        try:
+            _capacity.LEDGER.publish_gauges(self.registry,
+                                            device_ttl_s=5.0)
+        except Exception:
+            pass
         self.store.tick(now=now)
         try:
             self.slo_engine.check(now=now)

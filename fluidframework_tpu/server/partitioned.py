@@ -40,6 +40,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import capacity as _capacity
 from ..utils import flight_recorder
 from ..utils.telemetry import MetricsCollector, REGISTRY, TelemetryLogger
 from .oplog import PartitionedLog, partition_of
@@ -259,6 +260,12 @@ class PartitionedStringServing:
                 compact_every=compact_every, log=log,
                 sequencer=sequencer, mesh=mesh)
             eng.deli.partition = p
+            # partition-labeled capacity row: replace the engine's
+            # type-named ledger registration so /debug/memory's
+            # by_owner breakdown carries the partition index
+            _capacity.LEDGER.unregister(eng._capacity_key)
+            eng._capacity_key = _capacity.LEDGER.register(
+                f"StringServingEngine[part{p}]", eng._capacity_report)
             self.engines.append(eng)
         #: global row → doc id (hot-doc sketch + ack attribution)
         self._row_doc_id: List[Optional[str]] = [None] * self.n_docs
@@ -370,6 +377,12 @@ class PartitionedStringServing:
         new_eng = fol.promote()
         new_eng.deli.partition = p
         old = self.engines[p]
+        # swap the capacity-ledger row too: deposed leader out, promoted
+        # follower in under the same partition label
+        _capacity.LEDGER.unregister(old._capacity_key)
+        _capacity.LEDGER.unregister(new_eng._capacity_key)
+        new_eng._capacity_key = _capacity.LEDGER.register(
+            f"StringServingEngine[part{p}]", new_eng._capacity_report)
         self.engines[p] = new_eng
         self.dead_partitions.discard(p)
         self.metrics.inc("partition_promotions_total")
@@ -388,9 +401,14 @@ class PartitionedStringServing:
     def partition_stats(self) -> List[dict]:
         """Per-partition occupancy/residency rows for
         ``/debug/partitions`` (the door adds backlog + executor
-        occupancy on top)."""
+        occupancy on top). ``mem`` is the O(1) capacity rollup: the
+        partition's oplog tail + dedup-ledger window, charged from the
+        counters the hot paths already maintain — no walks here."""
         rows = []
         for p, eng in enumerate(self.engines):
+            log_ms = eng.log.mem_stats() if hasattr(eng.log, "mem_stats") \
+                else {"records": 0, "total_bytes": 0}
+            dd_ms = eng._dedup.mem_stats()
             rows.append({
                 "partition": p,
                 "resident_docs": eng.resident_docs,
@@ -399,8 +417,36 @@ class PartitionedStringServing:
                 "writer_epoch": eng.writer_epoch,
                 "dead": p in self.dead_partitions,
                 "follower_armed": p in self._followers,
+                "mem": {
+                    "oplog_tail_bytes": log_ms["total_bytes"],
+                    "oplog_tail_records": log_ms["records"],
+                    "dedup_bytes": dd_ms["bytes"],
+                    "dedup_entries": dd_ms["entries"],
+                },
             })
         return rows
+
+    def memory_rollup(self) -> dict:
+        """Full capacity census across partitions, one labeled row per
+        partition (host planes + device buffers via each engine's
+        ``_capacity_report``). Heavier than :meth:`partition_stats`'s
+        ``mem`` field — walks jax trees — so callers cache it behind
+        the census TTL."""
+        parts = []
+        for p, eng in enumerate(self.engines):
+            rep = eng._capacity_report()
+            parts.append({
+                "partition": p,
+                "host_bytes": sum(rep["host"].values()),
+                "device_bytes": sum(rep["device"].values()),
+                "docs": rep["docs"],
+            })
+        return {
+            "partitions": parts,
+            "host_bytes": sum(r["host_bytes"] for r in parts),
+            "device_bytes": sum(r["device_bytes"] for r in parts),
+            "docs": sum(r["docs"] for r in parts),
+        }
 
     def rebalance(self, sketch, k: int = 16, factor: float = 2.0) -> dict:
         """Run the skew guard against a drain-pass sketch."""

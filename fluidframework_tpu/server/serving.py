@@ -37,7 +37,7 @@ from ..utils.faultpoints import (
     SITE_APPLY_STALL, SITE_FLUSH_MID_BATCH, SITE_INGEST_MID_BATCH,
     SITE_SUBMIT_POST_SEQUENCE, fault_point,
 )
-from ..utils import flight_recorder, tracing
+from ..utils import capacity, flight_recorder, tracing
 from ..utils.telemetry import MetricsCollector, REGISTRY, TelemetryLogger
 from ..ops.map_kernel import TensorMapStore
 from ..ops.schema import OpKind
@@ -64,6 +64,10 @@ class DedupLedger:
         self.window = window
         self._led: Dict[Tuple[str, int], "collections.OrderedDict"] = {}
         self._last: Dict[Tuple[str, int], int] = {}
+        # capacity plane (ISSUE 19): total acked rows across all
+        # windows, maintained O(1) at every mutation — the census must
+        # never walk every (doc, client) window
+        self._entries = 0
         # the ack fan records on the ingress event loop while the
         # pipelined executor's sequencing worker looks up dup slots —
         # off the hot path (records are small per-window loops, lookups
@@ -77,9 +81,12 @@ class DedupLedger:
             led = self._led.get(key)
             if led is None:
                 led = self._led[key] = collections.OrderedDict()
+            if int(client_seq) not in led:
+                self._entries += 1
             led[int(client_seq)] = int(seq)
             while len(led) > self.window:
                 led.popitem(last=False)
+                self._entries -= 1
             if client_seq > self._last.get(key, 0):
                 self._last[key] = int(client_seq)
 
@@ -94,9 +101,12 @@ class DedupLedger:
                 led = self._led.get(key)
                 if led is None:
                     led = self._led[key] = collections.OrderedDict()
+                if int(client_seq) not in led:
+                    self._entries += 1
                 led[int(client_seq)] = int(seq)
                 while len(led) > self.window:
                     led.popitem(last=False)
+                    self._entries -= 1
                 if client_seq > self._last.get(key, 0):
                     self._last[key] = int(client_seq)
 
@@ -133,9 +143,12 @@ class DedupLedger:
                 with self._lock:
                     self._last[key] = max(self._last.get(key, 0),
                                           int(ent.get("last", 0)))
+                    old = self._led.get(key)
+                    self._entries -= len(old) if old is not None else 0
                     led = self._led[key] = collections.OrderedDict()
                     for cs, sq in ent.get("acked", []):
                         led[int(cs)] = int(sq)
+                    self._entries += len(led)
 
     @classmethod
     def load(cls, snapshot: Optional[dict],
@@ -148,7 +161,35 @@ class DedupLedger:
                 led = self._led[key] = collections.OrderedDict()
                 for cs, sq in ent.get("acked", []):
                     led[int(cs)] = int(sq)
+                self._entries += len(led)
         return self
+
+    # ------------------------------------------------------ capacity plane
+
+    def mem_stats(self) -> dict:
+        """O(1) capacity roll-up: acked rows, (doc, client) keys, and
+        the host-byte estimate (OrderedDict windows of boxed-int
+        entries plus the two key-tuple'd index dicts)."""
+        from ..utils import capacity as _cap
+        with self._lock:
+            n_keys = len(self._led)
+            n_entries = self._entries
+        return {
+            "keys": n_keys,
+            "entries": n_entries,
+            "bytes": int(n_entries * _cap.ODICT_ENTRY_BYTES
+                         + n_keys * (_cap.ODICT_EMPTY_BYTES
+                                     + 2 * _cap.DICT_ENTRY_BYTES + 120)),
+        }
+
+    def per_doc_entries(self) -> Dict[str, int]:
+        """Acked-row count per doc (census-time walk of the key space —
+        O(keys), used only for the top-K heaviest ranking)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for (doc, _cid), led in self._led.items():
+                out[doc] = out.get(doc, 0) + len(led)
+        return out
 
 
 def make_sequencer(kind: str = "python", clock=None):
@@ -424,6 +465,55 @@ class ServingEngineBase:
         # retained base references stay bounded)
         self.max_incremental_chain = 8
         self._chain_depth = 0
+        # capacity plane (ISSUE 19): register this engine's pull
+        # provider on the process ledger (weakly — engines are born and
+        # die by the hundreds in tests; the ledger must not pin them)
+        self._capacity_key = capacity.LEDGER.register(
+            type(self).__name__, self._capacity_report)
+
+    # ------------------------------------------------------ capacity plane
+
+    def _capacity_report(self) -> dict:
+        """Pull-provider for ``utils.capacity.LEDGER``: host/device
+        bytes by category across everything this engine owns — its
+        stores (each store's ``capacity_stats``), the dedup ledger, the
+        oplog's in-memory tails, and the row map — plus a top-K
+        heaviest-doc ranking (uniform device row share + that doc's
+        dedup window weight)."""
+        host: Dict[str, int] = {}
+        device: Dict[str, int] = {}
+        for attr in ("store", "mega_store", "axis_store"):
+            sub = getattr(self, attr, None)
+            if sub is None:
+                continue
+            stats = getattr(sub, "capacity_stats", None)
+            if stats is not None:
+                rep = stats()
+                for cat, v in rep.get("host", {}).items():
+                    host[cat] = host.get(cat, 0) + int(v)
+                for cat, v in rep.get("device", {}).items():
+                    device[cat] = device.get(cat, 0) + int(v)
+            elif getattr(sub, "state", None) is not None:
+                device["state"] = device.get("state", 0) \
+                    + capacity.device_nbytes(sub.state)
+        dd = self._dedup.mem_stats()
+        host["dedup"] = dd["bytes"]
+        log_stats = getattr(self.log, "mem_stats", None)
+        if log_stats is not None:
+            host["oplog_tail"] = int(log_stats()["total_bytes"])
+        host["row_map"] = capacity.dict_nbytes(
+            len(self._doc_rows), capacity.INT_DICT_ENTRY_BYTES + 60)
+        n_docs = max(1, int(getattr(self, "n_docs", 0) or 0))
+        row_share = sum(device.values()) // n_docs
+        per_doc = self._dedup.per_doc_entries()
+        ranked = sorted(
+            ((doc, row_share + per_doc.get(doc, 0)
+              * capacity.ODICT_ENTRY_BYTES)
+             for doc in self._doc_rows),
+            key=lambda kv: kv[1], reverse=True)[:8]
+        return capacity.report(host=host, device=device,
+                               docs=self.resident_docs,
+                               heaviest=ranked)
 
     # ------------------------------------------------ incremental summaries
     # The engine-agnostic dirty-row detection behind summarize(

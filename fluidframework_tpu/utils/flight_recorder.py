@@ -27,6 +27,23 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 
+#: name -> zero-arg provider whose output is embedded in every dump
+#: header (``utils.capacity`` installs the census + metrics snapshot
+#: so SLO-breach dumps carry the memory picture for offline forensics)
+_DUMP_CONTEXT: Dict[str, Any] = {}
+
+
+def add_dump_context(name: str, provider) -> None:
+    """Register a provider whose return value lands in dump headers
+    under ``name``. Providers must be cheap and must not raise (a
+    raising provider is recorded as its repr, never propagated)."""
+    _DUMP_CONTEXT[name] = provider
+
+
+def remove_dump_context(name: str) -> None:
+    _DUMP_CONTEXT.pop(name, None)
+
+
 def _jsonable(value: Any) -> Any:
     """Best-effort JSON coercion for dump lines (events may carry file
     handles, numpy scalars, exceptions — the dump must never fail)."""
@@ -124,6 +141,13 @@ class FlightRecorder:
             self._dump_seq += 1
         header = {"flight_recorder": reason, "dumped_at": time.time(),
                   "n_events": len(events), **(extra or {})}
+        # dump-time context (capacity census, metrics snapshot): best
+        # effort — forensics context must never block the evidence write
+        for ctx_name, provider in list(_DUMP_CONTEXT.items()):
+            try:
+                header.setdefault(ctx_name, provider())
+            except Exception as e:
+                header.setdefault(ctx_name, repr(e))
         with open(path, "w") as f:
             f.write(json.dumps(
                 {k: _jsonable(v) for k, v in header.items()}) + "\n")

@@ -262,6 +262,13 @@ def default_slos() -> List[SLOSpec]:
         # it pages: any nonzero shed rate is a breach
         SLOSpec.parse("rate(*replica_sheds_total) == 0",
                       name="replica_shed_rate"),
+        # capacity plane (ISSUE 19): the doc-memory budget must keep
+        # ≥5% headroom; the gauge reads 1.0 when no budget is set, so
+        # this only pages on processes that declared one. The breach
+        # dump carries the capacity census (flight-recorder dump
+        # context), so forensics see WHICH docs/owners ate the budget.
+        SLOSpec.parse("memory_budget_headroom > 0.05",
+                      name="memory_budget_headroom"),
     ]
 
 

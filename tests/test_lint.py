@@ -1,0 +1,107 @@
+"""Doc-registry lints (ISSUE 19 satellite): the AST sweeps that keep
+docs/OBSERVABILITY.md honest, as a tier-1 gate.
+
+Two lints:
+
+* metric-name lint — every metric name used anywhere in the tree
+  (``.inc(`` / ``.set_gauge(`` / ``.observe(`` with a literal name)
+  must appear backtick-quoted in the doc's metric registry table. A
+  counter nobody documented is a counter nobody reads.
+* route lint — every ``/debug/*`` route registered in
+  ``server/opsd.py`` must appear backtick-quoted in the doc's routes
+  table. An undocumented debug route is a debug route nobody curls.
+"""
+
+import ast
+import pathlib
+
+import pytest
+
+pytestmark = pytest.mark.telemetry
+
+PKG_ROOT = pathlib.Path(__file__).resolve().parent.parent \
+    / "fluidframework_tpu"
+DOC = PKG_ROOT.parent / "docs" / "OBSERVABILITY.md"
+
+
+# ------------------------------------------------------- metric-name lint
+
+def metric_names_in_tree():
+    """AST sweep of every ``.inc(`` / ``.set_gauge(`` / ``.observe(``
+    call whose first argument names a metric: string literals verbatim,
+    f-strings as their literal prefix + ``*`` (the per-reason counter
+    families), and both arms of a literal conditional. ``observe``
+    calls with a non-string first arg are ``Histogram.observe(value)``
+    — not a name site. Returns ``{name: "file:line"}``."""
+    roots = [PKG_ROOT,
+             PKG_ROOT.parent / "bench.py",
+             PKG_ROOT.parent / "tools"]
+    files = []
+    for r in roots:
+        files += sorted(r.rglob("*.py")) if r.is_dir() else [r]
+    kinds = {"inc", "set_gauge", "observe"}
+    names = {}
+
+    def literal_names(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [node.value]
+        if isinstance(node, ast.JoinedStr) and node.values and \
+                isinstance(node.values[0], ast.Constant):
+            return [str(node.values[0].value) + "*"]
+        if isinstance(node, ast.IfExp):
+            return literal_names(node.body) + literal_names(node.orelse)
+        return []
+
+    for path in files:
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in kinds and node.args):
+                continue
+            for name in literal_names(node.args[0]):
+                names.setdefault(name, f"{path.name}:{node.lineno}")
+    return names
+
+
+def test_metric_names_all_in_observability_doc():
+    doc = DOC.read_text()
+    names = metric_names_in_tree()
+    assert names, "AST sweep found no metric call sites — lint is broken"
+    assert len(names) > 20, f"sweep saw too few sites: {sorted(names)}"
+    missing = [f"{n} ({where})" for n, where in sorted(names.items())
+               if f"`{n}`" not in doc]
+    assert not missing, (
+        "metric names missing from docs/OBSERVABILITY.md's registry "
+        f"table: {missing}")
+
+
+# ------------------------------------------------------------- route lint
+
+def debug_routes_in_opsd():
+    """AST sweep of ``server/opsd.py`` for ``.route("<path>", ...)``
+    registrations. Returns ``{path: line}`` for every literal route."""
+    src = (PKG_ROOT / "server" / "opsd.py").read_text()
+    routes = {}
+    for node in ast.walk(ast.parse(src)):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "route" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            routes.setdefault(node.args[0].value, node.lineno)
+    return routes
+
+
+def test_all_debug_routes_documented():
+    doc = DOC.read_text()
+    routes = debug_routes_in_opsd()
+    assert routes, "route sweep found nothing — lint is broken"
+    assert any(r.startswith("/debug/") for r in routes), \
+        f"no /debug routes found: {sorted(routes)}"
+    missing = [f"{r} (opsd.py:{line})"
+               for r, line in sorted(routes.items())
+               if r.startswith("/debug/") and f"`{r}`" not in doc]
+    assert not missing, (
+        "/debug routes missing from docs/OBSERVABILITY.md's routes "
+        f"table: {missing}")

@@ -140,6 +140,72 @@ def _live_store(base_url: str, interval_s: float, polls: int
     return store
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def render_capacity(census=None, store=None) -> str:
+    """Capacity panel (ISSUE 19). Live mode renders the full
+    ``/debug/memory`` census (host/device split, headroom, heaviest +
+    coldest docs); file/demo mode reconstructs the headline from the
+    capacity gauges present in the metric store. Returns "" when the
+    export predates the capacity plane."""
+    lines = []
+    if census is not None and "error" not in census:
+        host = census.get("host", {})
+        dev = census.get("device", {})
+        docs = census.get("docs", {})
+        idle = census.get("idle", {})
+        lines.append("capacity")
+        lines.append(
+            f"  host {_fmt_bytes(host.get('total_bytes'))}"
+            f"  device {_fmt_bytes(dev.get('total_bytes'))}"
+            f"  docs {docs.get('resident', 0)}"
+            f"  headroom {census.get('headroom', 1.0):.2f}"
+            + (f"  budget {_fmt_bytes(census['budget_bytes'])}"
+               if census.get("budget_bytes") else ""))
+        by_owner = host.get("by_owner", {})
+        for owner in sorted(by_owner, key=by_owner.get, reverse=True)[:6]:
+            lines.append(f"    {owner:<32s} {_fmt_bytes(by_owner[owner])}")
+        for heavy in (census.get("top", {}).get("heaviest") or [])[:4]:
+            lines.append(f"  heavy {heavy.get('doc')}: "
+                         f"{_fmt_bytes(heavy.get('bytes'))}")
+        for cold in (census.get("top", {}).get("coldest") or [])[:4]:
+            lines.append(f"  cold  {cold.get('doc', cold.get('row'))}: "
+                         f"idle {cold.get('idle_s', 0):.1f}s")
+        for owner, snap in sorted(idle.items()):
+            p99 = snap.get("idle_p99_s")
+            if p99 is not None:
+                lines.append(f"  idle[{owner}] "
+                             f"p50 {snap.get('idle_p50_s', 0):.1f}s"
+                             f"  p99 {p99:.1f}s"
+                             f"  max {snap.get('idle_max_s', 0):.1f}s")
+    elif store is not None:
+        vals = {n: store.latest(n)
+                for n in ("doc_resident_bytes", "device_buffer_bytes",
+                          "resident_docs_total", "memory_budget_headroom",
+                          "doc_memory_budget_bytes")}
+        if any(v is not None for v in vals.values()):
+            lines.append("capacity")
+            lines.append(
+                f"  host {_fmt_bytes(vals['doc_resident_bytes'])}"
+                f"  device {_fmt_bytes(vals['device_buffer_bytes'])}"
+                f"  docs {int(vals['resident_docs_total'] or 0)}"
+                f"  headroom "
+                f"{(vals['memory_budget_headroom'] or 1.0):.2f}"
+                + (f"  budget "
+                   f"{_fmt_bytes(vals['doc_memory_budget_bytes'])}"
+                   if vals["doc_memory_budget_bytes"] else ""))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("jsonl", nargs="?", help="TimeSeriesStore export")
@@ -185,6 +251,16 @@ def main(argv=None) -> int:
                  if fnmatch.fnmatchcase(n, args.names)]
     print(store.render_sparklines(names=names, width=args.width,
                                   active_only=not args.all), end="")
+    census = None
+    if args.url:
+        try:
+            census = json.loads(_fetch(base + "/debug/memory"))
+        except (OSError, ValueError):
+            census = None
+    panel = render_capacity(census=census, store=store)
+    if panel:
+        print()
+        print(panel, end="")
     if args.no_slo:
         return 0
     if args.url:
