@@ -813,3 +813,251 @@ class ResilientColumnarClient:
             sock.close()
         with self._acked_cv:
             self._acked_cv.notify_all()
+
+
+class ResilientObserver:
+    """Reconnecting read-only client for the observer door
+    (``server.observer.ObserverDoor``).
+
+    The read-plane counterpart of the wrappers above: no ops to
+    resubmit, so resilience means *resuming the window stream without a
+    gap or a dup*. The client tracks the last applied window id and the
+    last applied sequenced seq per doc; a reconnect (or a server-side
+    shed ``gap`` frame) re-enters with ``from_wid = last_wid + 1`` so
+    the hub's retained ring replays exactly the missed windows — a
+    resubscribe requests catch-up, never full hydration. When the ring
+    no longer reaches back (``catchup_needed``), the client surfaces it
+    (``catchup_needed`` counter) for the generation-diff ladder
+    (docs/READ_PLANE.md) and rejoins at the live head.
+
+    Exactly-once accounting is structural: window ids are published
+    monotonically with no holes, so ``wid <= last_wid`` is a dup
+    (skipped whole) and ``wid > last_wid + 1`` is a gap; per-doc
+    sequenced seqs back that up at op granularity (``dups`` /
+    ``op_gaps``). The reconnect-storm test pins all four counters at
+    zero.
+    """
+
+    def __init__(self, host: str, port: int, name: str = "",
+                 rng=None, attempts: int = 8,
+                 base_delay: float = 0.02,
+                 dial_timeout: float = 10.0,
+                 on_op: Optional[Callable] = None,
+                 byte_rate: Optional[float] = None,
+                 byte_burst: Optional[float] = None):
+        self.host = host
+        self.port = port
+        self.name = name or "resilient-observer"
+        self.attempts = attempts
+        self.dial_timeout = dial_timeout
+        self.on_op = on_op
+        self.byte_rate = byte_rate
+        self.byte_burst = byte_burst
+        self._backoff = Backoff(base=base_delay, cap=1.0, rng=rng,
+                                metric="observer_reconnect_backoffs_total")
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+        self._sock: Optional[socket.socket] = None
+        #: doc → last applied sequenced seq (the resume cursor)
+        self.doc_seqs: Dict[str, int] = {}
+        self.last_wid = 0
+        self.windows_applied = 0
+        self.ops_applied = 0
+        self.window_dups = 0     # whole windows skipped (wid replayed)
+        self.dups = 0            # per-op dedup drops
+        self.gaps = 0            # window-id holes observed
+        self.op_gaps = 0         # per-doc seq holes observed
+        self.reconnects = 0
+        self.sheds = 0           # server-side shed notices received
+        self.catchup_needed = 0  # ring could not reach our cursor
+        self.gave_up = False
+        #: state of the in-flight window run
+        self._skip = False
+        self._cops_docs: List[str] = []
+        self._thread = threading.Thread(
+            target=self._run, name=f"observer:{self.name}", daemon=True)
+        self._thread.start()
+
+    # -------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        attempts_left = self.attempts
+        first = True
+        while not self._closed and attempts_left > 0:
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.dial_timeout)
+                sock.settimeout(None)
+                self._sock = sock
+                sub: Dict[str, Any] = {"t": "subscribe",
+                                       "name": self.name}
+                if self.last_wid:
+                    # resume, not rehydrate: only the missed windows
+                    sub["from_wid"] = self.last_wid + 1
+                if self.byte_rate is not None:
+                    sub["byte_rate"] = self.byte_rate
+                if self.byte_burst is not None:
+                    sub["byte_burst"] = self.byte_burst
+                sock.sendall(colwire.encode_json(sub))
+                if not first:
+                    with self._lock:
+                        self.reconnects += 1
+                    REGISTRY.inc("observer_reconnects_total")
+                first = False
+                self._backoff.reset()
+                attempts_left = self.attempts
+                self._recv_loop(sock)
+            except (OSError, ConnectionError, ValueError):
+                pass
+            if self._closed:
+                break
+            attempts_left -= 1
+            if attempts_left > 0:
+                time.sleep(self._backoff.next_delay())
+        if not self._closed:
+            self.gave_up = True
+        with self._cv:
+            self._cv.notify_all()
+
+    def _recv_loop(self, sock: socket.socket) -> None:
+        while not self._closed:
+            ftype, payload = colwire.read_frame(sock)
+            self._on_frame(ftype, payload, sock)
+
+    # ------------------------------------------------------------ decode
+
+    def _on_frame(self, ftype: int, payload: bytes,
+                  sock: socket.socket) -> None:
+        if ftype == ord("J"):
+            msg = json.loads(bytes(payload))
+            self._on_control(msg, sock)
+            return
+        if self._skip:
+            return
+        if ftype in (ord("B"), ord("R")):
+            self._on_op_frame(payload, rich=ftype == ord("R"))
+        elif ftype == ord("T"):
+            self._on_tree_frame(payload)
+
+    def _on_control(self, msg: dict, sock: socket.socket) -> None:
+        t = msg.get("t")
+        if t == "window":
+            wid = int(msg["wid"])
+            with self._lock:
+                if wid <= self.last_wid:
+                    # replay overlap: skip the whole run, count the dup
+                    self._skip = True
+                    self.window_dups += 1
+                    return
+                if self.last_wid and wid > self.last_wid + 1:
+                    self.gaps += 1
+                self._skip = False
+                self.last_wid = wid
+                self.windows_applied += 1
+        elif t == "subscribed":
+            with self._lock:
+                if msg.get("catchup_needed"):
+                    # the ring no longer reaches our cursor: the
+                    # generation-diff ladder owns the gap from here;
+                    # the stream itself resumes at the live head
+                    self.catchup_needed += 1
+                if not self.last_wid:
+                    self.last_wid = int(msg["next_wid"]) - 1
+        elif t == "gap":
+            # server shed us a window (byte budget): we are parked;
+            # ask for a ring replay from our cursor on this socket
+            with self._lock:
+                self.sheds += 1
+                from_wid = self.last_wid + 1
+            sock.sendall(colwire.encode_json(
+                {"t": "resume", "from_wid": from_wid}))
+        elif t == "catchup_needed":
+            # resume refused: ring too short — ladder territory
+            with self._lock:
+                self.catchup_needed += 1
+                self.last_wid = 0   # rejoin at the live head
+            raise ConnectionError("ring behind cursor")
+        elif t == "rec" and msg.get("fmt") == "cops":
+            self._cops_docs = list(msg["docs"])
+        elif t == "rec" and msg.get("fmt") == "json":
+            for doc, seq, client, contents in msg["ops"]:
+                self._apply(doc, int(seq), int(client), contents)
+
+    def _on_op_frame(self, payload: bytes, rich: bool) -> None:
+        texts, props, off = colwire.parse_op_tables(payload, rich)
+        recs = np.frombuffer(payload, colwire._OP_DTYPE, offset=off)
+        docs = self._cops_docs
+        for r in recs:
+            kind = int(r["kind"])
+            op: Dict[str, Any] = {"kind": kind, "a0": int(r["a0"]),
+                                  "a1": int(r["a1"])}
+            if kind == 0 and texts:              # INSERT
+                op["text"] = texts[int(r["tidx"])]
+            elif kind == 2 and props:            # ANNOTATE
+                op["props"] = props[int(r["tidx"])]
+            self._apply(docs[int(r["row"])], int(r["cseq"]),
+                        int(r["ref"]), op)
+
+    def _on_tree_frame(self, payload: bytes) -> None:
+        from ..server.read_plane import decode_tree_frame
+        header, rec_op, recs = decode_tree_frame(payload)
+        docs = header["docs"]
+        for i, seq in enumerate(header["seq"]):
+            self._apply(docs[int(header["doc"][i])], int(seq),
+                        int(header["client"][i]),
+                        {"tree_rec": int(rec_op[i])})
+
+    def _apply(self, doc: str, seq: int, client: int, op: Any) -> None:
+        with self._cv:
+            last = self.doc_seqs.get(doc, 0)
+            if seq <= last:
+                self.dups += 1
+                return
+            if last and seq > last + 1:
+                self.op_gaps += 1
+            self.doc_seqs[doc] = seq
+            self.ops_applied += 1
+            self._cv.notify_all()
+        if self.on_op is not None:
+            self.on_op(doc, seq, client, op)
+
+    # ------------------------------------------------------------- waits
+
+    def wait_ops(self, n: int, timeout: float = 30.0) -> bool:
+        """Block until ``n`` distinct ops have been applied."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self.ops_applied < n and not self._closed \
+                    and not self.gave_up:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+            return self.ops_applied >= n
+
+    # ------------------------------------------------------------- chaos
+
+    def kill_socket(self) -> None:
+        """Simulate network loss mid-stream; the loop redials with
+        jitter and resubscribes from ``last_wid + 1``."""
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+
+    def close(self) -> None:
+        self._closed = True
+        sock = self._sock
+        try:
+            sock.sendall(colwire.encode_json({"t": "close"}))
+        except (OSError, AttributeError):
+            pass
+        if sock is not None:
+            sock.close()
+        with self._cv:
+            self._cv.notify_all()
+        self._thread.join(timeout=5)

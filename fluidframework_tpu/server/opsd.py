@@ -261,6 +261,7 @@ class OpsServer:
         self._t_started = time.time()
         self._sketches: List[SpaceSaving] = []
         self._partition_providers: List[Callable[[], List[dict]]] = []
+        self._reader_hubs: List[Any] = []
         self._on_tick: List[Callable[[], None]] = []
         self._tick_stop = threading.Event()
         self._ticker: Optional[threading.Thread] = None
@@ -273,7 +274,8 @@ class OpsServer:
                      .route("/debug/latency", self._r_latency)
                      .route("/debug/partitions", self._r_partitions)
                      .route("/debug/memory", self._r_memory)
-                     .route("/debug/docs", self._r_docs))
+                     .route("/debug/docs", self._r_docs)
+                     .route("/debug/readers", self._r_readers))
 
     # -------------------------------------------------------- attachments
 
@@ -288,6 +290,15 @@ class OpsServer:
         """Expose a partitioned door's per-partition rows (occupancy,
         backlog, resident docs — ISSUE 18) at ``/debug/partitions``."""
         self._partition_providers.append(provider)
+        return self
+
+    def add_readers(self, hub: Any) -> "OpsServer":
+        """Expose an observer hub's per-subscriber rows (window lag,
+        delivered volume, shed counts — ISSUE 20) at ``/debug/readers``.
+        ``hub`` is anything with ``.readers()`` and ``.stats()``
+        (``server.observer.ObserverHub``); multiple doors may each
+        attach their own."""
+        self._reader_hubs.append(hub)
         return self
 
     def on_tick(self, fn: Callable[[], None]) -> "OpsServer":
@@ -375,6 +386,30 @@ class OpsServer:
                 rows.append({"error": repr(e)})
         return json_body(_finite({"count": len(rows),
                                   "partitions": rows}))
+
+    def _r_readers(self, _q: Dict[str, str]) -> Tuple[str, bytes]:
+        """Read-plane census (ISSUE 20): per-subscriber lag/shed rows
+        from every attached observer hub plus the fleet aggregate."""
+        rows: List[dict] = []
+        agg = {"subscribers": 0, "windows_published": 0,
+               "ops_published": 0, "worst_lag_windows": 0,
+               "sheds": 0, "parked": 0, "staleness_p99_s": 0.0}
+        for hub in self._reader_hubs:
+            try:
+                rows.extend(hub.readers())
+                s = hub.stats()
+            except Exception as e:   # debug route: never 500 the plane
+                rows.append({"error": repr(e)})
+                continue
+            for k in ("subscribers", "windows_published",
+                      "ops_published", "sheds", "parked"):
+                agg[k] += s.get(k, 0)
+            agg["worst_lag_windows"] = max(
+                agg["worst_lag_windows"], s.get("worst_lag_windows", 0))
+            agg["staleness_p99_s"] = max(
+                agg["staleness_p99_s"], s.get("staleness_p99_s", 0.0))
+        return json_body(_finite({**agg, "count": len(rows),
+                                  "readers": rows}))
 
     def _r_memory(self, q: Dict[str, str]) -> Tuple[str, bytes]:
         """Capacity census (ISSUE 19): host planes by owner/category,

@@ -1,0 +1,512 @@
+"""The read plane: encode-once window fanout + device-computed catch-up.
+
+Reference counterpart: Broadcaster → Redis → socket.io fan-out in
+Routerlicious (SURVEY.md §1) — the reference pushes every sequenced op
+to every listening client exactly once per op, through a pub/sub tier
+that encodes the payload ONCE and lets the transport multiplex bytes.
+Here the same economics ride the columnar wire (ISSUE 20): the write
+door's vectorized encoders (``columnar_ingress.encode_op_batch``, the
+tree-wire table layout) run *in reverse* over each sequenced window —
+one pack per window regardless of subscriber count — and
+``server.observer.ObserverHub`` fans the identical bytes to N read-only
+connections. The marginal per-subscriber cost is a byte-budget check
+and a ``send``, never a re-encode.
+
+Three surfaces, one module:
+
+- **window encoding** (:func:`encode_window`): the durable log's
+  columnar records (``ColumnarOps``, ``TreeRecordOps``) become wire
+  frames directly from their planes. String batches re-enter the
+  ingress's own ``B``/``R`` layout (record-local doc index in ``row``,
+  sequenced ``seq`` in the ``cseq`` slot, ``client`` in ``ref`` —
+  the read direction repurposes the width-coded record verbatim),
+  chunked to the u8 table bounds. Tree batches ship their raw kernel
+  record planes plus the batch-local tables as one binary ``T`` frame
+  (the ``tree_wire`` format, server→observer). Map/matrix/non-columnar
+  records fall back to a JSON ``rec`` frame via ``expand()``.
+- **the pump** (:class:`ReadPlane`): per-partition offset cursors over
+  the engine's durable log (the ``OplogFollower`` idiom) cut a window
+  per flush — ``ServingEngineBase._after_flush`` pokes the attached
+  plane, so windows land at device pace, not at a poll timer's.
+- **device-computed catch-up** (:func:`build_generation_diff` /
+  :func:`apply_generation_diff`): diff two ``SummaryGenerationStore``
+  generations with the stores' existing fused gather kernels
+  (``snapshot_rows`` / ``snapshot_delta`` against the FROM generation's
+  append-only table bases) into a synthetic incremental-summary delta.
+  A joiner at generation G−k applies the diff over its local base with
+  the SAME chain-resolution machinery live incremental summaries use
+  (``resolve_summary_chain`` → ``apply_row_snapshot`` → tail replay
+  from the TO generation's ``log_offsets``) — a compact diff plus the
+  short oplog tail instead of a full-tail replay.
+
+Staleness is a first-class SLO: every delivered window and every
+replica catch-up feeds ``read_staleness_p99_s`` (see
+``utils.slo.default_slos`` — bounded staleness, docs/READ_PLANE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.protocol import ColumnarWireKind, MessageType
+from ..utils.telemetry import REGISTRY
+from .columnar_ingress import _OP_DTYPE, encode_frame, encode_json
+
+#: binary tree-window frame: u32 header-length + JSON header (tables +
+#: sequencing columns) + raw int32 ``rec_op`` (R,) + ``recs`` (R, 8)
+#: planes — the tree_wire layout pointed at observers
+_FT_T = ord("T")
+_U32 = struct.Struct("<I")
+
+#: u8 table bound of the B/R layouts (counts are single bytes on the
+#: wire); frames chunk at this many distinct texts/props
+_TABLE_MAX = 255
+#: u16 bound of the row/a0/a1 record slots
+_U16_MAX = 0xFFFF
+
+_WIRE_OK = {int(ColumnarWireKind.INSERT), int(ColumnarWireKind.REMOVE),
+            int(ColumnarWireKind.ANNOTATE)}
+
+
+# ---------------------------------------------------------------- encoding
+
+def _encode_json_ops(rec, wid: int) -> List[bytes]:
+    """JSON fallback: expand a log record to per-op rows. Map / matrix /
+    generic-dict batches and plain per-op messages take this path — the
+    volume families (string, tree) never do."""
+    ops = []
+    msgs = rec.expand() if hasattr(rec, "expand") else (rec,)
+    for m in msgs:
+        if m.type != MessageType.OP:
+            continue
+        ops.append([m.doc_id, m.seq, m.client_id, m.contents])
+    if not ops:
+        return []
+    return [encode_json({"t": "rec", "fmt": "json", "wid": wid,
+                         "ops": ops})]
+
+
+def _encode_string_cops(rec, wid: int) -> List[bytes]:
+    """One string ``ColumnarOps`` record → the ingress's own ``B``/``R``
+    frames, encoded straight from the planes (no per-op expansion).
+
+    Slot repurposing for the read direction: ``row`` carries the
+    RECORD-LOCAL doc index (the ``docs`` table rides in the meta
+    frame), ``cseq`` carries the sequenced ``seq``, ``ref`` the writing
+    client. ``kind``/``a0``/``a1``/``tidx`` keep their write-path
+    meaning — observers parse the frame with the same
+    ``parse_op_tables`` the door uses. Chunks at the u8 table bound and
+    falls back to JSON when any plane overflows its wire slot."""
+    n = len(rec.seq)
+    kind = np.asarray(rec.kind, np.int64)
+    a0 = np.asarray(rec.a0, np.int64)
+    a1 = np.asarray(rec.a1, np.int64)
+    doc = np.asarray(rec.doc, np.int64)
+    seq = np.asarray(rec.seq, np.int64)
+    client = np.asarray(rec.client, np.int64)
+    if (not set(np.unique(kind).tolist()) <= _WIRE_OK
+            or (a0 < 0).any() or a0.max(initial=0) > _U16_MAX
+            or (a1 < 0).any() or a1.max(initial=0) > _U16_MAX
+            or doc.max(initial=0) > _U16_MAX
+            or seq.max(initial=0) > 0xFFFFFFFF
+            or (client < 0).any() or client.max(initial=0) > 0xFFFFFFFF):
+        return _encode_json_ops(rec, wid)
+
+    # per-op payload-table handle: broadcast text = handle 0 everywhere
+    texts = rec.texts if rec.texts is not None else [rec.text]
+    tidx = (np.asarray(rec.tidx, np.int64) if rec.tidx is not None
+            else np.zeros(n, np.int64))
+    props = rec.props
+    if any(len(t.encode()) > _U16_MAX for t in texts):
+        return _encode_json_ops(rec, wid)
+
+    frames = [encode_json({"t": "rec", "fmt": "cops", "wid": wid,
+                           "docs": list(rec.doc_ids), "n": int(n)})]
+    is_ann = kind == int(ColumnarWireKind.ANNOTATE)
+    # texts and props share the tidx plane but index DIFFERENT tables;
+    # chunk so each chunk's distinct handles fit the u8 counts
+    start = 0
+    while start < n:
+        t_seen: Dict[int, int] = {}
+        p_seen: Dict[int, int] = {}
+        end = start
+        while end < n:
+            h = int(tidx[end])
+            seen = p_seen if is_ann[end] else t_seen
+            if h not in seen and len(seen) >= _TABLE_MAX:
+                break
+            seen.setdefault(h, len(seen))
+            end += 1
+        sl = slice(start, end)
+        out = np.zeros(end - start, _OP_DTYPE)
+        out["row"] = doc[sl]
+        out["kind"] = kind[sl]
+        out["a0"] = a0[sl]
+        out["a1"] = a1[sl]
+        out["cseq"] = seq[sl]
+        out["ref"] = client[sl]
+        local = np.zeros(end - start, np.int64)
+        ann_sl = is_ann[sl]
+        local[~ann_sl] = [t_seen[int(h)] for h in tidx[sl][~ann_sl]]
+        if ann_sl.any():
+            local[ann_sl] = [p_seen[int(h)] for h in tidx[sl][ann_sl]]
+        out["tidx"] = local
+        chunk_texts = [texts[h] for h in
+                       sorted(t_seen, key=t_seen.get)]
+        chunk_props = ([props[h] for h in
+                        sorted(p_seen, key=p_seen.get)]
+                       if p_seen else None)
+        from .columnar_ingress import encode_op_batch
+        frames.append(encode_op_batch(chunk_texts, out,
+                                      props=chunk_props))
+        start = end
+    return frames
+
+
+def _encode_tree_recs(rec, wid: int) -> List[bytes]:
+    """One ``TreeRecordOps`` record → a single binary ``T`` frame: the
+    JSON header carries the batch-local tables (ids/fields/types/values
+    — the tree_wire tables) and the per-op sequencing columns; the raw
+    int32 kernel planes (``rec_op``, ``recs``) ride appended verbatim —
+    bit-identical to what recovery replays, zero per-op decode."""
+    rec_op = np.ascontiguousarray(rec.rec_op, np.int32)
+    recs = np.ascontiguousarray(rec.recs, np.int32)
+    header = {
+        "t": "tree", "wid": wid, "docs": list(rec.doc_ids),
+        "doc": np.asarray(rec.doc).tolist(),
+        "seq": np.asarray(rec.seq).tolist(),
+        "client": np.asarray(rec.client).tolist(),
+        "ids": list(rec.ids), "fields": list(rec.fields),
+        "types": list(rec.types), "values": list(rec.values),
+        "n_recs": int(recs.shape[0]),
+    }
+    hb = json.dumps(header).encode()
+    payload = b"".join([_U32.pack(len(hb)), hb,
+                        rec_op.tobytes(), recs.tobytes()])
+    return [encode_frame(b"T", payload)]
+
+
+def decode_tree_frame(payload) -> Tuple[dict, np.ndarray, np.ndarray]:
+    """Inverse of :func:`_encode_tree_recs`: (header, rec_op, recs)."""
+    (hlen,) = _U32.unpack_from(payload, 0)
+    header = json.loads(bytes(payload[4:4 + hlen]))
+    r = int(header["n_recs"])
+    off = 4 + hlen
+    rec_op = np.frombuffer(payload, np.int32, count=r, offset=off)
+    recs = np.frombuffer(payload, np.int32, count=r * 8,
+                         offset=off + r * 4).reshape(r, 8)
+    return header, rec_op, recs
+
+
+def encode_record(rec, wid: int) -> Tuple[List[bytes], int]:
+    """One durable-log record → its observer frames + op count."""
+    fam = getattr(rec, "family", None)
+    if fam == "str":
+        return _encode_string_cops(rec, wid), len(rec.seq)
+    if hasattr(rec, "recs"):          # TreeRecordOps
+        return _encode_tree_recs(rec, wid), len(rec.seq)
+    frames = _encode_json_ops(rec, wid)
+    if hasattr(rec, "expand"):
+        n = len(rec.seq)
+    else:
+        n = 1 if rec.type == MessageType.OP else 0
+    return frames, n
+
+
+def encode_window(records, wid: int) -> Tuple[bytes, int]:
+    """Encode ONE sequenced window (the records a flush made durable)
+    into a single byte run: a ``J`` window header then every record's
+    frames. This happens once per window; the hub fans the identical
+    bytes to every subscriber — the encode-once contract the bench's
+    amortization ratio pins."""
+    frames: List[bytes] = []
+    n_ops = 0
+    # records from different partitions interleave arbitrarily; a
+    # stable sort by first sequenced seq restores append order (per-doc
+    # seqs are monotone across a doc's records)
+    keyed = []
+    for rec in records:
+        seqs = getattr(rec, "seq", 0)
+        if isinstance(seqs, (int, np.integer)):
+            first = int(seqs)
+        else:
+            first = int(np.min(seqs)) if len(seqs) else 0
+        keyed.append((first, len(keyed), rec))
+    keyed.sort(key=lambda kr: (kr[0], kr[1]))
+    for _, _, rec in keyed:
+        fs, n = encode_record(rec, wid)
+        frames.extend(fs)
+        n_ops += n
+    header = encode_json({"t": "window", "wid": wid, "n_ops": n_ops,
+                          "n_frames": len(frames)})
+    return header + b"".join(frames), n_ops
+
+
+# ------------------------------------------------------------- the pump
+
+class ReadPlane:
+    """Log→observer pump for one serving engine: per-partition offset
+    cursors over the durable log; each :meth:`pump` cuts everything new
+    into ONE window, encodes it once, and publishes the bytes to the
+    hub. Attach with ``engine.attach_read_plane(plane)`` — the engine
+    pokes the plane after every nonzero flush, so windows are carved at
+    device-flush pace (wire pace), not at a poll interval."""
+
+    def __init__(self, engine, hub=None, from_start: bool = False):
+        from .observer import ObserverHub
+        self.engine = engine
+        self.hub = hub if hub is not None else ObserverHub()
+        self.log = engine.log
+        self._offsets = [0 if from_start else self.log.size(p)
+                         for p in range(self.log.n_partitions)]
+        self._lock = threading.Lock()
+        self.windows = 0
+        self.ops_published = 0
+
+    def pump(self) -> int:
+        """Encode + publish one window of everything newly durable;
+        returns ops published (0 = no new records, no window)."""
+        with self._lock:
+            records = []
+            for p in range(self.log.n_partitions):
+                size = self.log.size(p)
+                if size <= self._offsets[p]:
+                    continue
+                records.extend(self.log.read(
+                    p, from_offset=self._offsets[p], to_offset=size))
+                self._offsets[p] = size
+            if not records:
+                return 0
+            wid = self.hub.next_wid()
+            payload, n_ops = encode_window(records, wid)
+            self.hub.publish(wid, payload, n_ops)
+            self.windows += 1
+            self.ops_published += n_ops
+            REGISTRY.inc("read_windows_total")
+        return n_ops
+
+
+# ------------------------------------------------- device-computed catch-up
+
+def summary_doc_seqs(summary: dict) -> Dict[str, int]:
+    """Per-doc sequenced seq recorded in a summary's sequencer
+    checkpoint — the host-side changed-doc detector (no device read).
+    The python checkpoint is read directly; the native blob restores a
+    throwaway sequencer and queries it."""
+    ckpt = summary["deli"]
+    if isinstance(ckpt, dict) and "native" not in ckpt:
+        return {d: int(s["seq"]) for d, s in ckpt.items()}
+    from .serving import restore_sequencer
+    seqr = restore_sequencer(ckpt)
+    return {d: int(seqr.doc_seq(d)) for d in summary["doc_rows"]}
+
+
+def _changed(from_summary: dict, to_summary: dict
+             ) -> Tuple[set, set]:
+    """(changed doc ids, dirty TO-store rows) between two generations —
+    the same host-side detection live incremental summaries run
+    (``_dirty_rows_since``), but over two stored checkpoints."""
+    from_seqs = summary_doc_seqs(from_summary)
+    to_seqs = summary_doc_seqs(to_summary)
+    to_rows = to_summary["doc_rows"]
+    from_rows = from_summary["doc_rows"]
+    changed_docs = {d for d, s in to_seqs.items()
+                    if from_seqs.get(d) != s}
+    dirty = {to_rows[d] for d in changed_docs if d in to_rows}
+    # rows whose doc→row mapping moved between the generations: their
+    # planes were rewritten outside the op stream (graduation, reuse)
+    dirty |= {r for d, r in from_rows.items() if to_rows.get(d) != r}
+    dirty |= {r for d, r in to_rows.items() if from_rows.get(d) != r}
+    return changed_docs, dirty
+
+
+def _interner_len(snap) -> int:
+    """Table length of an exported interner snapshot (``_Interner``
+    exports a dict, ``ValueInterner`` / payload lists export lists)."""
+    if isinstance(snap, dict):
+        return len(snap["names"])
+    return len(snap)
+
+
+def build_generation_diff(family: str, from_summary: dict,
+                          to_summary: dict) -> dict:
+    """Diff two FULL generations of one engine lineage into a synthetic
+    incremental-summary delta: restore the TO store, gather ONLY the
+    dirty rows with the stores' fused gather kernels
+    (``snapshot_rows`` / ``snapshot_delta``), against the FROM
+    generation's append-only table bases. The result is exactly what a
+    live ``summarize(incremental=True)`` would have captured between
+    the two checkpoints — ``apply_generation_diff`` resolves it with
+    the engines' own chain machinery.
+
+    Both summaries must be full summaries from the SAME store lineage
+    (the ``SummaryGenerationStore`` ladder guarantees this): the
+    append-only tables of the FROM generation must prefix the TO
+    generation's. Sharded-matrix summaries are rejected — re-shard by
+    full restore instead."""
+    for s, name in ((from_summary, "from"), (to_summary, "to")):
+        if s.get("kind") == "delta":
+            raise ValueError(f"{name}_summary is a delta — generation "
+                             "diffs run between FULL generations")
+    changed_docs, dirty_rows = _changed(from_summary, to_summary)
+    diff = {k: to_summary[k] for k in
+            ("deli", "log_offsets", "chain_heads", "doc_rows",
+             "min_seq")}
+    if "attribution" in to_summary:
+        diff["attribution"] = to_summary["attribution"]
+    diff["kind"] = "delta"
+    diff["base"] = None           # the reader attaches its local base
+    from .serving import DedupLedger
+    diff["dedup"] = DedupLedger.load(
+        to_summary.get("dedup")).snapshot(docs=changed_docs)
+    base_m = {(d, int(c)) for d, c in from_summary.get("members") or []}
+    cur_m = {(d, int(c)) for d, c in to_summary.get("members") or []}
+    diff["members_delta"] = {
+        "join": sorted([d, c] for d, c in cur_m - base_m),
+        "leave": sorted([d, c] for d, c in base_m - cur_m)}
+    dirty = sorted(dirty_rows)
+
+    if family == "string":
+        from ..ops.string_store import TensorStringStore
+        store = TensorStringStore.restore(to_summary["store"])
+        diff["store_delta"] = store.snapshot_rows(
+            dirty, len(from_summary["store"]["payloads"]),
+            _interner_len(from_summary["store"]["prop_values"]))
+        # small/rare tiers ride in full, as in live deltas
+        diff["mega_store"] = to_summary.get("mega_store")
+        diff["mega_rows"] = dict(to_summary.get("mega_rows", {}))
+        diff["graduated"] = to_summary.get("graduated", {})
+    elif family == "map":
+        from ..ops.map_kernel import TensorMapStore
+        store = TensorMapStore.restore(to_summary["store"])
+        diff["store_delta"] = store.snapshot_rows(
+            dirty, _interner_len(from_summary["store"]["values"]))
+    elif family == "matrix":
+        if "sharded_docs" in to_summary["store"]:
+            raise ValueError("sharded matrix generations cannot diff — "
+                             "restore the full summary onto the mesh")
+        from ..ops.axis_kernel import TensorAxisStore
+        from ..ops.matrix_kernel import TensorMatrixStore
+        store = TensorMatrixStore.restore(to_summary["store"])
+        axis = TensorAxisStore.restore(to_summary["axis_store"])
+        diff["cells_delta"] = store.snapshot_delta({
+            "cell_ids": len(from_summary["store"]["cell_ids"]),
+            "values": _interner_len(from_summary["store"]["values"]),
+        }) if dirty else None
+        axis_rows = [a for r in dirty for a in (2 * r, 2 * r + 1)]
+        diff["axis_delta"] = axis.snapshot_rows(
+            axis_rows, len(from_summary["axis_store"]["runs"]))
+        fww = to_summary["fww"]
+        meta = to_summary["cell_meta"]
+        diff["fww_delta"] = {r: fww.get(r) for r in dirty}
+        diff["cell_meta_delta"] = {r: meta.get(r) for r in dirty}
+        diff["n_docs"] = to_summary["n_docs"]
+    elif family == "tree":
+        from ..ops.tree_store import TensorTreeStore
+        store = TensorTreeStore.restore(to_summary["store"])
+        diff["store_delta"] = store.snapshot_rows(dirty, {
+            k: _interner_len(from_summary["store"][k])
+            for k in ("ids", "fields", "types", "values")})
+        diff["graduated"] = to_summary.get("graduated", {})
+    else:
+        raise ValueError(f"unknown family {family!r}")
+    REGISTRY.inc("read_catchup_diffs_total")
+    return diff
+
+
+def apply_generation_diff(family: str, diff: dict, base_summary: dict,
+                          log, **kwargs):
+    """Catch up a joiner: attach the joiner's LOCAL base generation to
+    the diff and resolve through the engine's own load path — base
+    restore, dirty-row scatter, sequencer restore at the TO checkpoint,
+    then tail replay from the TO generation's ``log_offsets`` only (the
+    short tail). Returns the caught-up engine."""
+    from ..testing.chaos import engine_class
+    d = dict(diff)
+    d["base"] = base_summary
+    return engine_class(family).load(d, log, **kwargs)
+
+
+# ------------------------------------------------------------ staleness
+
+class StalenessTracker:
+    """Bounded sample window feeding the ``read_staleness_p99_s`` gauge
+    — one tracker shared by the hub (window delivery delay) and the
+    replicas (catch-up drain lag), so the SLO judges the whole read
+    plane."""
+
+    def __init__(self, keep: int = 1024):
+        self.keep = keep
+        self._samples: List[float] = []
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._samples.append(float(seconds))
+            del self._samples[:-self.keep]
+            ss = sorted(self._samples)
+            p99 = ss[min(len(ss) - 1, int(0.99 * len(ss)))]
+        REGISTRY.set_gauge("read_staleness_p99_s", p99)
+
+    def p99(self) -> float:
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            ss = sorted(self._samples)
+            return ss[min(len(ss) - 1, int(0.99 * len(ss)))]
+
+
+#: process-wide tracker (the gauge is process-scoped anyway)
+STALENESS = StalenessTracker()
+
+
+class ReadReplica:
+    """A read replica riding ``OplogFollower.catch_up()`` with a
+    bounded-staleness SLO: each :meth:`poll` drains the leader's new
+    durable records into the replica engine and samples how stale the
+    replica WAS at the start of the drain (the age of the oldest record
+    it had not yet applied, from the records' append timestamps). Reads
+    served from ``replica.engine`` are then bounded-stale by the SLO
+    the sample stream feeds (``read_staleness_p99_s``)."""
+
+    def __init__(self, leader, family: str = "string",
+                 summary: Optional[dict] = None,
+                 tracker: Optional[StalenessTracker] = None):
+        from ..parallel.replicated import OplogFollower
+        self.follower = OplogFollower(leader, family=family,
+                                      summary=summary)
+        self.engine = self.follower.engine
+        self.tracker = tracker if tracker is not None else STALENESS
+        self.polls = 0
+        self.ops_applied = 0
+
+    def poll(self) -> int:
+        """One catch-up beat; returns ops applied, samples staleness."""
+        t0 = time.time()
+        oldest = None
+        log = self.follower.log
+        for p in range(log.n_partitions):
+            if log.size(p) <= self.follower._offsets[p]:
+                continue
+            for rec in log.read(p,
+                                from_offset=self.follower._offsets[p]):
+                ts = getattr(rec, "timestamp", 0.0) or 0.0
+                if ts > 0:
+                    oldest = ts if oldest is None else min(oldest, ts)
+                break       # only the oldest unapplied record per part
+        n = self.follower.catch_up()
+        self.polls += 1
+        self.ops_applied += n
+        if n:
+            # staleness = how long the oldest drained record had been
+            # durable before this replica applied it; a caught-up poll
+            # contributes nothing (staleness is only defined over lag)
+            self.tracker.observe(max(0.0, t0 - oldest)
+                                 if oldest else 0.0)
+        return n

@@ -394,6 +394,10 @@ class ServingEngineBase:
         self._queue: List[Tuple[int, SequencedDocumentMessage]] = []
         self._flushes_since_compact = 0
         self._min_seq: Dict[str, int] = {}
+        # read plane (ISSUE 20): attach_read_plane() hangs a pump here;
+        # _after_flush pokes it so observer windows are carved at
+        # device-flush pace (encode-once fanout, server/read_plane.py)
+        self._read_plane = None
         # opt-in (enable_attribution): ONE attributor per document —
         # Deli seqs are per-doc, so a shared table would collide across docs
         self._attributors: Optional[Dict[str, Any]] = None
@@ -1002,11 +1006,20 @@ class ServingEngineBase:
         """Apply the queued window on device; returns messages applied."""
         raise NotImplementedError
 
+    def attach_read_plane(self, plane) -> None:
+        """Hang a ``read_plane.ReadPlane`` on this engine: every flush
+        that applied ops pumps one encoded observer window. Detach with
+        ``attach_read_plane(None)``."""
+        self._read_plane = plane
+
     def _after_flush(self, n: int) -> None:
         if n:
             self._flushes_since_compact += 1
             if self._flushes_since_compact >= self.compact_every:
                 self.compact()
+            plane = self._read_plane
+            if plane is not None:
+                plane.pump()
 
     def compact(self) -> None:
         self.metrics.inc("compactions")
@@ -1718,6 +1731,12 @@ class StringServingEngine(ServingEngineBase):
             else:
                 self.recover_overflowed()
         n_dup = int(getattr(w, "dup_acked", 0) or 0)
+        # read plane (ISSUE 20): the columnar window is durable — pump
+        # one encoded observer window at ingest pace (the fast path
+        # never passes through flush()/_after_flush)
+        plane = self._read_plane
+        if plane is not None and w.n_ok:
+            plane.pump()
         w.marks["log1"] = time.perf_counter()
         return {"seq": w.seq_rs, "nacked": int(nacked.sum()) - n_dup,
                 "dup_acked": n_dup, "marks": w.marks}
@@ -3542,6 +3561,11 @@ class TreeServingEngine(ServingEngineBase):
             "serving.ingest_records", elapsed_ms, ops=int(w.n_ok),
             nacked=int(w.nacked.sum()), seq_ms=w.seq_ms,
             dispatch_ms=w.dispatch_ms, log_ms=log_ms)
+        # read plane (ISSUE 20): pump at ingest pace, as in the string
+        # fast path — tree records ship as binary T frames
+        plane = self._read_plane
+        if plane is not None and w.n_ok:
+            plane.pump()
         return {"seq": w.out_seq, "nacked": int(w.nacked.sum())}
 
     def ingest_records(self, doc_ids: Optional[List[str]], clients,

@@ -269,6 +269,12 @@ def default_slos() -> List[SLOSpec]:
         # context), so forensics see WHICH docs/owners ate the budget.
         SLOSpec.parse("memory_budget_headroom > 0.05",
                       name="memory_budget_headroom"),
+        # read plane (ISSUE 20): bounded staleness — a delivered window
+        # or replica catch-up must land within 2s of durability at p99.
+        # The gauge only moves on processes that serve readers, so
+        # write-only deployments never judge it.
+        SLOSpec.parse("read_staleness_p99_s < 2",
+                      name="read_staleness"),
     ]
 
 

@@ -279,6 +279,33 @@ def partition_block(rec: dict, source: str = "?") -> str:
     return json.dumps(out)
 
 
+def read_plane_block(rec: dict, source: str = "?") -> str:
+    """Read-plane fenced block (ISSUE 20: delivery ops/s by subscriber
+    count, the encode-once amortization ratio, generation-diff catch-up
+    vs full-tail replay, staleness p99 under the write storm); an
+    explicit no-committed-record row on records predating the phase."""
+    rf = rec.get("read_fanout")
+    if not isinstance(rf, dict) or not rf or "skipped" in rf:
+        return _no_record("read_delivery_ops_per_sec", "ops/s", source)
+    out = {"metric": "read_delivery_ops_per_sec", "unit": "ops/s"}
+    if rec.get("read_delivery_ops_per_sec") is not None:
+        out["value"] = rec["read_delivery_ops_per_sec"]
+    out.update({k: rf[k] for k in (
+        "windows", "total_ops", "encode_ms_per_window",
+        "marginal_us_per_sub_window_1024", "amortization_ratio_1024",
+        "catchup_speedup_4096", "staleness_p99_s", "error") if k in rf})
+    fanout = rf.get("fanout")
+    if isinstance(fanout, dict):
+        out["delivery_ops_per_sec_by_subs"] = {
+            n: row.get("delivery_ops_per_sec") for n, row in sorted(
+                fanout.items(), key=lambda kv: int(kv[0]))
+            if isinstance(row, dict)}
+    catchup = rf.get("catchup")
+    if isinstance(catchup, dict):
+        out["catchup_by_tail"] = catchup
+    return json.dumps(out)
+
+
 _FENCE_RE = re.compile(r"```json\n.*?\n```", re.S)
 
 
@@ -326,7 +353,9 @@ def regenerate(root: Path, json_path: Path | None = None,
                             overload_block(rec, src)),
                            ("## Durability", durability_block(rec, src)),
                            ("## Partitioned serving",
-                            partition_block(rec, src))):
+                            partition_block(rec, src)),
+                           ("## Read plane",
+                            read_plane_block(rec, src))):
         if extra is not None:
             updated = update_section(updated, heading, extra)
     if write:

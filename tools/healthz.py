@@ -206,6 +206,55 @@ def render_capacity(census=None, store=None) -> str:
     return "\n".join(lines) + ("\n" if lines else "")
 
 
+def render_readers(census=None, store=None) -> str:
+    """Readers panel (ISSUE 20). Live mode renders ``/debug/readers``
+    (subscriber count, worst window lag, shed/park totals, staleness
+    p99, the laggiest subscriber rows); file/demo mode reconstructs the
+    headline from the read-plane gauges/counters in the metric store.
+    Returns "" when the export predates the read plane."""
+    lines = []
+    if census is not None and "error" not in census:
+        rows = census.get("readers") or []
+        if census.get("subscribers") or rows:
+            lines.append("readers")
+            lines.append(
+                f"  subscribers {census.get('subscribers', 0)}"
+                f"  worst-lag {census.get('worst_lag_windows', 0)}w"
+                f"  sheds {census.get('sheds', 0)}"
+                f"  parked {census.get('parked', 0)}"
+                f"  staleness-p99 "
+                f"{census.get('staleness_p99_s', 0.0):.3f}s")
+            laggy = sorted((r for r in rows if "sid" in r),
+                           key=lambda r: r.get("lag_windows", 0),
+                           reverse=True)
+            for r in laggy[:6]:
+                lines.append(
+                    f"    {r.get('name', '?'):<24s}"
+                    f" lag {r.get('lag_windows', 0)}w"
+                    f"  ops {r.get('delivered_ops', 0)}"
+                    f"  sheds {r.get('sheds', 0)}"
+                    + ("  PARKED" if r.get("parked") else ""))
+    elif store is not None:
+        vals = {n: store.latest(n)
+                for n in ("observer_subscribers",
+                          "observer_delivery_ops_per_sec",
+                          "read_staleness_p99_s",
+                          "observer_sheds_total",
+                          "read_windows_total")}
+        if any(v is not None for v in vals.values()):
+            lines.append("readers")
+            lines.append(
+                f"  subscribers {int(vals['observer_subscribers'] or 0)}"
+                f"  delivery "
+                f"{(vals['observer_delivery_ops_per_sec'] or 0.0):.0f}"
+                f" ops/s"
+                f"  windows {int(vals['read_windows_total'] or 0)}"
+                f"  sheds {int(vals['observer_sheds_total'] or 0)}"
+                f"  staleness-p99 "
+                f"{(vals['read_staleness_p99_s'] or 0.0):.3f}s")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("jsonl", nargs="?", help="TimeSeriesStore export")
@@ -258,6 +307,16 @@ def main(argv=None) -> int:
         except (OSError, ValueError):
             census = None
     panel = render_capacity(census=census, store=store)
+    if panel:
+        print()
+        print(panel, end="")
+    readers = None
+    if args.url:
+        try:
+            readers = json.loads(_fetch(base + "/debug/readers"))
+        except (OSError, ValueError):
+            readers = None
+    panel = render_readers(census=readers, store=store)
     if panel:
         print()
         print(panel, end="")

@@ -90,6 +90,11 @@ DECLARED_FLOORS: Dict[str, float] = {
     # Arms on the first round with the host cores to overlap the
     # partition sequencers; stale-record until BENCH_r06 lands.
     "partition_columnar_ops_per_sec": 17.4e3,
+    # ISSUE 20 floor: delivered ops/s at 1024 observer subscribers —
+    # the encode-once fanout makes delivery a sink call per subscriber,
+    # so even the 1-core dev host should clear millions/s. Arms on the
+    # first committed clearing round; stale-record until BENCH_r06.
+    "read_delivery_ops_per_sec": 5e6,
 }
 
 #: round number each floor was declared in (ISSUE 17 satellite): a
@@ -105,6 +110,7 @@ FLOOR_DECLARED_ROUND: Dict[str, int] = {
     "tree_serving_ops_per_sec": 7,
     "matrix_serving_ops_per_sec": 7,
     "partition_columnar_ops_per_sec": 6,
+    "read_delivery_ops_per_sec": 6,
 }
 
 #: Known-variance note (headline drift, r04 → r05): the merged-kernel
@@ -415,6 +421,73 @@ def judge_partition(rounds: List[dict]) -> List[dict]:
     return out
 
 
+def judge_read(rounds: List[dict]) -> List[dict]:
+    """Gate on the newest round's ``read_fanout`` phase (ISSUE 20).
+
+    Two structural gates — both are properties of the code, not the
+    host, so they regress outright:
+
+    - ``amortization_ratio_1024`` must stay <= 0.05: the per-subscriber
+      marginal cost at 1024 subscribers as a fraction of the
+      single-subscriber encode+deliver cost. Above the bar means the
+      fanout is re-doing per-subscriber work the encode-once contract
+      forbids;
+    - ``catchup_speedup_4096`` must stay >= 5: the generation-diff
+      catch-up vs full-tail replay at a 4096-op tail. Below the bar the
+      device-computed diff stopped paying for itself.
+
+    Staleness p99 is info-class here (the live SLO judges it against
+    its bound); the absolute delivery throughput rides the
+    ``read_delivery_ops_per_sec`` declared floor. Rounds predating the
+    phase produce no verdict."""
+    if not rounds:
+        return []
+    rf = rounds[-1].get("read_fanout")
+    if not isinstance(rf, dict) or not rf or "skipped" in rf:
+        return []
+    if "error" in rf:
+        return [{"metric": "read_fanout", "verdict": REGRESS,
+                 "value": None, "expected": "phase completes",
+                 "delta_pct": None,
+                 "note": f"phase errored: {rf['error']}"}]
+    out: List[dict] = []
+    ratio = rf.get("amortization_ratio_1024")
+    if isinstance(ratio, (int, float)) and not isinstance(ratio, bool):
+        ok = ratio <= 0.05
+        out.append({
+            "metric": "read_fanout.amortization_ratio_1024",
+            "verdict": FLAT if ok else REGRESS, "value": ratio,
+            "expected": "<= 0.05 (encode-once contract)",
+            "delta_pct": None,
+            "note": "marginal per-subscriber cost is noise vs the "
+                    "one-time encode" if ok else
+                    "per-subscriber work crept into the fanout — a "
+                    "copy or re-encode on the publish path"})
+    speedup = rf.get("catchup_speedup_4096")
+    if isinstance(speedup, (int, float)) and \
+            not isinstance(speedup, bool):
+        ok = speedup >= 5
+        out.append({
+            "metric": "read_fanout.catchup_speedup_4096",
+            "verdict": FLAT if ok else REGRESS, "value": speedup,
+            "expected": ">= 5x vs full-tail replay (4096-op tail)",
+            "delta_pct": None,
+            "note": "generation diff + short tail beats rehydration"
+                    if ok else "the diff path lost its edge — gather "
+                               "kernels or diff sizing regressed"})
+    stale = rf.get("staleness_p99_s")
+    if isinstance(stale, (int, float)) and not isinstance(stale, bool):
+        out.append({
+            "metric": "read_fanout.staleness_p99_s",
+            "verdict": INFO, "value": stale,
+            "expected": "< 2 s (read_staleness SLO bound)",
+            "delta_pct": None,
+            "note": "window delivery delay under the write storm with "
+                    "64 live subscribers — the live SLO engine judges "
+                    "the bound, this is the bench's sample"})
+    return out
+
+
 def judge_durability(rounds: List[dict],
                      spill_dir: Optional[str] = None) -> List[dict]:
     """Hard gate on durable-layer integrity (ISSUE 10): the newest
@@ -556,6 +629,7 @@ def main(argv=None) -> int:
     verdicts += judge_resilience(rounds)
     verdicts += judge_overload(rounds)
     verdicts += judge_partition(rounds)
+    verdicts += judge_read(rounds)
     verdicts += judge_durability(rounds, spill_dir=args.spill_dir)
     failed = has_regression(verdicts)
     if args.json:
